@@ -1,0 +1,11 @@
+"""dervet_tpu — TPU-native distributed-energy-resource valuation framework.
+
+A ground-up JAX/XLA re-design with the capabilities of EPRI's DER-VET
+(reference studied at /root/reference): techno-economic dispatch
+optimization, optimal sizing, microgrid reliability, and multi-decade
+cost-benefit analysis for DER portfolios — built around a canonical LP IR
+solved by a batched first-order (PDHG) solver on TPU instead of per-problem
+CVXPY/GLPK calls.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,56 @@
+"""CPU reference LP solver (scipy HiGHS) for cross-validation.
+
+Plays the role the pinned GLPK/ECOS/OSQP stack plays in the reference
+(requirements.txt:1-27): an exact simplex/IPM answer to validate the
+first-order TPU solver against (acceptance: NPV within 1% — see BASELINE.md).
+Also usable as a per-problem fallback backend (``backend='cpu'``).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import scipy.sparse as sp
+from scipy.optimize import linprog
+
+from .lp import LP
+
+
+class CPUResult(NamedTuple):
+    x: np.ndarray
+    obj: float
+    status: int       # 0 = optimal
+    message: str
+
+
+def solve_lp_cpu(lp: LP, c=None, q=None, l=None, u=None) -> CPUResult:
+    c = lp.c if c is None else np.asarray(c)
+    q = lp.q if q is None else np.asarray(q)
+    l = lp.l if l is None else np.asarray(l)
+    u = lp.u if u is None else np.asarray(u)
+    K_eq = lp.K[: lp.n_eq]
+    K_ge = lp.K[lp.n_eq:]
+    A_ub = (-K_ge).tocsc() if K_ge.shape[0] else None
+    b_ub = -q[lp.n_eq:] if K_ge.shape[0] else None
+    A_eq = K_eq.tocsc() if lp.n_eq else None
+    b_eq = q[: lp.n_eq] if lp.n_eq else None
+    res = linprog(c, A_ub=A_ub, b_ub=b_ub, A_eq=A_eq, b_eq=b_eq,
+                  bounds=np.stack([l, u], axis=1), method="highs")
+    x = res.x if res.x is not None else np.full(lp.n, np.nan)
+    return CPUResult(x=x, obj=float(res.fun) if res.fun is not None else np.nan,
+                     status=int(res.status), message=str(res.message))
+
+
+def solve_lp_cpu_batch(lp: LP, c_b=None, q_b=None, l_b=None, u_b=None):
+    """Serial loop over a batch — reference semantics, used only in tests."""
+    B = max(arr.shape[0] for arr in (c_b, q_b, l_b, u_b) if arr is not None)
+
+    def pick(arr, i, default):
+        if arr is None:
+            return default
+        return arr[i] if arr.ndim == 2 else arr
+
+    return [solve_lp_cpu(lp,
+                         pick(c_b, i, lp.c), pick(q_b, i, lp.q),
+                         pick(l_b, i, lp.l), pick(u_b, i, lp.u))
+            for i in range(B)]

@@ -1,0 +1,225 @@
+"""Canonical LP intermediate representation and block builder.
+
+This is the TPU-native replacement for the reference's CVXPY expression-tree
+assembly (reference: dervet/MicrogridScenario.py:322-346 builds per-window
+CVXPY objectives/constraints from every DER and value stream; we instead have
+every component emit *structured blocks* — cost vectors, bound vectors and
+sparse constraint rows — into one canonical LP that a batched first-order
+solver consumes).
+
+Canonical form::
+
+    minimize    c @ x
+    subject to  (K @ x - q)[:n_eq]  == 0        (equality rows first)
+                (K @ x - q)[n_eq:]  >= 0        (inequality rows, GE sense)
+                l <= x <= u
+
+All rows are stored with GE sense; ``add_rows(..., sense='le')`` negates the
+block on entry.  ``q``/``c``/``l``/``u`` may later be swapped per-scenario
+(batched) while ``K`` is shared across the batch — the structure of the
+dispatch problem is scenario-independent, only prices/loads/bounds vary.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import scipy.sparse as sp
+
+_INF = np.inf
+
+
+@dataclasses.dataclass(frozen=True)
+class VarRef:
+    """A named contiguous slice of the decision vector."""
+
+    name: str
+    start: int
+    size: int
+
+    @property
+    def sl(self) -> slice:
+        return slice(self.start, self.start + self.size)
+
+
+@dataclasses.dataclass
+class LP:
+    """Assembled canonical LP (numpy / scipy on host; ship to device to solve)."""
+
+    c: np.ndarray            # (n,)
+    K: sp.csr_matrix         # (m, n) equality rows first
+    q: np.ndarray            # (m,)
+    n_eq: int                # rows [0, n_eq) are ==, rest are >=
+    l: np.ndarray            # (n,)
+    u: np.ndarray            # (n,)
+    var_refs: Dict[str, VarRef]
+    # name -> list of (start, stop) row ranges; a group name may be used by
+    # several add_rows calls, and eq/ge rows are emitted in separate regions
+    row_groups: Dict[str, List[Tuple[int, int]]]
+    c0: float = 0.0          # constant objective offset (reporting only)
+
+    @property
+    def n(self) -> int:
+        return self.c.shape[0]
+
+    @property
+    def m(self) -> int:
+        return self.q.shape[0]
+
+    def dense_K(self) -> np.ndarray:
+        return np.asarray(self.K.todense())
+
+    def value(self, x: np.ndarray, name: str) -> np.ndarray:
+        """Extract a named variable block from a solution vector (batched ok)."""
+        return x[..., self.var_refs[name].sl]
+
+
+class LPBuilder:
+    """Accumulates variable blocks, bounds, cost terms, and constraint rows.
+
+    Components (DER technologies, value streams, the POI) call:
+      * ``var(name, size, lb, ub)``  — register a decision-variable block
+      * ``add_cost(ref, vec)``       — add a linear cost on a block
+      * ``add_rows(name, terms, sense, rhs)`` — add ``k`` constraint rows where
+        each term is ``(ref, coef)`` and ``coef`` is either a scalar, a
+        ``(k,)`` diagonal (applied to a size-``k`` block), or a ``(k, ref.size)``
+        dense/sparse matrix.
+    """
+
+    def __init__(self):
+        self._vars: List[VarRef] = []
+        self._by_name: Dict[str, VarRef] = {}
+        self._lb: Dict[str, np.ndarray] = {}
+        self._ub: Dict[str, np.ndarray] = {}
+        self._cost: List[Tuple[VarRef, np.ndarray]] = []
+        self._c0 = 0.0
+        # rows split by sense; each entry: (group_name, k, terms, rhs)
+        self._eq_rows: List[Tuple[str, int, list, np.ndarray]] = []
+        self._ge_rows: List[Tuple[str, int, list, np.ndarray]] = []
+        self._n = 0
+
+    # ---------------- variables ----------------
+    def var(self, name: str, size: int, lb=-_INF, ub=_INF) -> VarRef:
+        if name in self._by_name:
+            raise ValueError(f"duplicate variable block {name!r}")
+        ref = VarRef(name, self._n, size)
+        self._vars.append(ref)
+        self._by_name[name] = ref
+        self._lb[name] = np.broadcast_to(np.asarray(lb, np.float64), (size,)).copy()
+        self._ub[name] = np.broadcast_to(np.asarray(ub, np.float64), (size,)).copy()
+        self._n += size
+        return ref
+
+    def __getitem__(self, name: str) -> VarRef:
+        return self._by_name[name]
+
+    def has(self, name: str) -> bool:
+        return name in self._by_name
+
+    def set_bounds(self, ref: VarRef, lb=None, ub=None):
+        if ref.name not in self._by_name:
+            raise KeyError(f"unknown variable block {ref.name!r}")
+        if lb is not None:
+            self._lb[ref.name] = np.broadcast_to(
+                np.asarray(lb, np.float64), (ref.size,)).copy()
+        if ub is not None:
+            self._ub[ref.name] = np.broadcast_to(
+                np.asarray(ub, np.float64), (ref.size,)).copy()
+
+    # ---------------- objective ----------------
+    def add_cost(self, ref: VarRef, vec) -> None:
+        self._cost.append((ref, np.broadcast_to(np.asarray(vec, np.float64), (ref.size,)).copy()))
+
+    def add_const_cost(self, val: float) -> None:
+        self._c0 += float(val)
+
+    # ---------------- constraints ----------------
+    def add_rows(self, name: str, terms, sense: str, rhs) -> None:
+        """Add ``k`` rows:  sum_j coef_j @ x[ref_j]  (sense)  rhs.
+
+        ``sense`` in {'eq', 'ge', 'le'}.  'le' rows are negated into 'ge'.
+        """
+        if sense not in ("eq", "ge", "le"):
+            raise ValueError(f"bad sense {sense!r}")
+        if not terms:
+            raise ValueError(f"constraint group {name!r} has no terms")
+        norm_terms = []
+        k = None
+        for ref, coef in terms:
+            coef = np.asarray(coef, np.float64) if not sp.issparse(coef) else coef
+            if sp.issparse(coef):
+                kk = coef.shape[0]
+            elif coef.ndim == 2:
+                kk = coef.shape[0]
+            elif coef.ndim == 1:
+                kk = coef.shape[0]
+            else:  # scalar => diagonal over the whole block
+                kk = ref.size
+            if k is None:
+                k = kk
+            elif k != kk:
+                raise ValueError(f"inconsistent row counts in {name}: {k} vs {kk}")
+            norm_terms.append((ref, coef))
+        rhs = np.broadcast_to(np.asarray(rhs, np.float64), (k,)).copy()
+        if sense == "le":
+            norm_terms = [(r, -c) for r, c in norm_terms]
+            rhs = -rhs
+        target = self._eq_rows if sense == "eq" else self._ge_rows
+        target.append((name, k, norm_terms, rhs))
+
+    # ---------------- assembly ----------------
+    @staticmethod
+    def _coef_to_coo(coef, ref: VarRef, row0: int, k: int):
+        """Yield (rows, cols, vals) arrays for one term."""
+        if sp.issparse(coef):
+            coo = coef.tocoo()
+            return coo.row + row0, coo.col + ref.start, coo.data
+        coef = np.asarray(coef, np.float64)
+        if coef.ndim == 2:
+            rows, cols = np.nonzero(coef)
+            return rows + row0, cols + ref.start, coef[rows, cols]
+        if coef.ndim == 1 and ref.size == k:
+            idx = np.nonzero(coef)[0]
+            return idx + row0, idx + ref.start, coef[idx]
+        if coef.ndim == 1:
+            raise ValueError("1-D coef requires matching block size")
+        # scalar diagonal
+        idx = np.arange(ref.size)
+        return idx + row0, idx + ref.start, np.full(ref.size, float(coef))
+
+    def build(self) -> LP:
+        n = self._n
+        c = np.zeros(n)
+        for ref, vec in self._cost:
+            c[ref.sl] += vec
+        l = (np.concatenate([self._lb[v.name] for v in self._vars])
+             if self._vars else np.zeros(0))
+        u = (np.concatenate([self._ub[v.name] for v in self._vars])
+             if self._vars else np.zeros(0))
+
+        rows_i, cols_i, vals_i = [], [], []
+        q_parts, groups = [], {}
+        row0 = 0
+        for block_list in (self._eq_rows, self._ge_rows):
+            for name, k, terms, rhs in block_list:
+                for ref, coef in terms:
+                    r, cidx, v = self._coef_to_coo(coef, ref, row0, k)
+                    rows_i.append(r)
+                    cols_i.append(cidx)
+                    vals_i.append(v)
+                groups.setdefault(name, []).append((row0, row0 + k))
+                q_parts.append(rhs)
+                row0 += k
+            if block_list is self._eq_rows:
+                n_eq = row0
+        m = row0
+        K = sp.coo_matrix(
+            (np.concatenate(vals_i) if vals_i else np.zeros(0),
+             (np.concatenate(rows_i) if rows_i else np.zeros(0, int),
+              np.concatenate(cols_i) if cols_i else np.zeros(0, int))),
+            shape=(m, n),
+        ).tocsr()
+        q = np.concatenate(q_parts) if q_parts else np.zeros(0)
+        return LP(c=c, K=K, q=q, n_eq=n_eq, l=l, u=u,
+                  var_refs=dict(self._by_name), row_groups=groups, c0=self._c0)

@@ -1,0 +1,366 @@
+"""Batched restarted PDHG (PDLP-family) LP solver in pure JAX.
+
+TPU-native replacement for the reference's per-problem CPU solver calls
+(reference: CVXPY 1.0.31 + GLPK/ECOS/OSQP behind
+``cvx.Problem(...).solve()``, e.g. dervet/MicrogridValueStreams/
+Reliability.py:270-272 and the storagevet Scenario solve loop).  Instead of
+one expression-tree canonicalization + simplex call per optimization window,
+we solve the canonical-form LP
+
+    min c@x   s.t.  (K@x - q)[:n_eq] == 0,  (K@x - q)[n_eq:] >= 0,  l<=x<=u
+
+with primal-dual hybrid gradient — a few dense matvecs per iteration, which
+XLA maps straight onto the MXU — and ``jax.vmap`` over the scenario axis
+(sensitivity cases / sizing sweeps / Monte-Carlo draws) so thousands of
+scenarios solve simultaneously.  ``K`` is shared across the batch; only
+``c, q, l, u`` vary per scenario.
+
+Algorithmic ingredients (see PAPERS.md: PDLP / MPAX): Ruiz l-inf
+equilibration, step size from a power-iteration bound on ||K||2, iterate
+averaging, adaptive restarts on the KKT score, and primal-weight updates on
+restart.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .lp import LP
+
+
+# ---------------------------------------------------------------------------
+# Preconditioning (host-side, numpy — runs once per problem structure)
+# ---------------------------------------------------------------------------
+
+def ruiz_scaling(K, iters: int = 10):
+    """Iterated l-inf Ruiz equilibration.  Returns (d_r, d_c) with
+    K_hat = diag(d_r) @ K @ diag(d_c) approximately balanced."""
+    K = K.tocsr(copy=True)
+    m, n = K.shape
+    d_r = np.ones(m)
+    d_c = np.ones(n)
+    for _ in range(iters):
+        absK = abs(K)
+        row_max = absK.max(axis=1).toarray().ravel()
+        col_max = absK.max(axis=0).toarray().ravel()
+        r = 1.0 / np.sqrt(np.maximum(row_max, 1e-12))
+        c = 1.0 / np.sqrt(np.maximum(col_max, 1e-12))
+        r[row_max == 0] = 1.0
+        c[col_max == 0] = 1.0
+        K = K.multiply(r[:, None]).multiply(c[None, :]).tocsr()
+        d_r *= r
+        d_c *= c
+    return d_r, d_c
+
+
+# ---------------------------------------------------------------------------
+# Options / results
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class PDHGOptions:
+    eps_abs: float = 1e-6
+    eps_rel: float = 1e-4
+    max_iters: int = 100_000
+    check_every: int = 64
+    # restart scheme thresholds (simplified PDLP)
+    beta_sufficient: float = 0.2
+    beta_necessary: float = 0.8
+    artificial_restart: int = 1024     # force restart after this many inner iters
+    primal_weight_smoothing: float = 0.5
+    power_iters: int = 40
+    ruiz_iters: int = 10
+    step_size_safety: float = 0.99
+    dtype: jnp.dtype = jnp.float32
+    # TPU MXU default precision is bf16, which is NOT enough for PDHG to
+    # converge (the iteration amplifies matvec rounding through the box
+    # projections); force full-f32 matmuls for the K matvecs.
+    precision: jax.lax.Precision = jax.lax.Precision.HIGHEST
+
+
+class PDHGResult(NamedTuple):
+    x: jax.Array          # (..., n) unscaled primal solution
+    y: jax.Array          # (..., m) unscaled dual solution
+    obj: jax.Array        # (...,)   primal objective c@x
+    converged: jax.Array  # (...,)   bool
+    iters: jax.Array      # (...,)   iterations used
+    prim_res: jax.Array   # (...,)   final primal residual (inf norm)
+    gap: jax.Array        # (...,)   final |primal-dual| gap
+
+
+class _State(NamedTuple):
+    x: jax.Array
+    y: jax.Array
+    x_sum: jax.Array
+    y_sum: jax.Array
+    inner: jax.Array        # iters since restart
+    total: jax.Array        # total iters
+    omega: jax.Array        # primal weight
+    x_restart: jax.Array    # iterate at last restart (for omega update)
+    y_restart: jax.Array
+    mu_restart: jax.Array   # KKT score at last restart
+    mu_prev: jax.Array      # KKT score at previous check
+    converged: jax.Array
+    done_x: jax.Array       # frozen solution once converged
+    done_y: jax.Array
+    iters_at_conv: jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Core solver on the *scaled* problem, structured for jit + vmap
+# ---------------------------------------------------------------------------
+
+def _kkt_terms(Kh, x, y, c, q, l, u, eq_mask, dr, dc, prec):
+    """Residuals/objectives of the UNSCALED problem given scaled iterates.
+
+    x_unscaled = dc * x, y_unscaled = dr * y; K = D_r^-1 Kh D_c^-1.
+    """
+    xu = dc * x
+    yu = dr * y
+    Kx = jnp.matmul(Kh, x, precision=prec) / dr        # = K @ xu
+    KTy = jnp.matmul(Kh.T, y, precision=prec) / dc     # = K.T @ yu
+    r = q - Kx
+    viol = jnp.where(eq_mask, jnp.abs(r), jnp.maximum(r, 0.0))
+    prim_res = jnp.max(viol) if viol.size else jnp.asarray(0.0, x.dtype)
+    lam = c - KTy                           # reduced costs
+    lam_pos = jnp.maximum(lam, 0.0)
+    lam_neg = jnp.minimum(lam, 0.0)
+    l_fin = jnp.isfinite(l)
+    u_fin = jnp.isfinite(u)
+    # dual residual: reduced-cost mass that no finite bound can absorb
+    dres_vec = jnp.where(l_fin, 0.0, lam_pos) + jnp.where(u_fin, 0.0, -lam_neg)
+    dual_res = jnp.max(dres_vec) if dres_vec.size else jnp.asarray(0.0, x.dtype)
+    pobj = c @ xu
+    dobj = q @ yu + jnp.sum(jnp.where(l_fin, lam_pos * l, 0.0)
+                            + jnp.where(u_fin, lam_neg * u, 0.0))
+    gap = jnp.abs(pobj - dobj)
+    return prim_res, dual_res, gap, pobj, dobj
+
+
+def _converged(prim_res, dual_res, gap, pobj, dobj, q_norm, c_norm, opts):
+    ok_p = prim_res <= opts.eps_abs + opts.eps_rel * q_norm
+    ok_d = dual_res <= opts.eps_abs + opts.eps_rel * c_norm
+    ok_g = gap <= opts.eps_abs + opts.eps_rel * (jnp.abs(pobj) + jnp.abs(dobj))
+    return ok_p & ok_d & ok_g
+
+
+def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
+    """Build the jittable scaled-space solve(Kh, c, q, l, u, dr, dc, eta)."""
+
+    prec = opts.precision
+
+    def one_iter(carry, _, Kh, c, q, l, u, eq_mask, omega, eta):
+        x, y = carry
+        tau = eta / omega
+        sigma = eta * omega
+        grad = c - jnp.matmul(Kh.T, y, precision=prec)
+        x1 = jnp.clip(x - tau * grad, l, u)
+        y1 = y + sigma * (q - jnp.matmul(Kh, 2.0 * x1 - x, precision=prec))
+        y1 = jnp.where(eq_mask, y1, jnp.maximum(y1, 0.0))
+        return (x1, y1), (x1, y1)
+
+    def solve(Kh, c, q, l, u, dr, dc, eta):
+        dtype = opts.dtype
+        eq_mask = jnp.arange(m) < n_eq
+        # scale problem data into the preconditioned space
+        c_s = (c * dc).astype(dtype)
+        q_s = (q * dr).astype(dtype)
+        l_s = jnp.where(jnp.isfinite(l), l / dc, l).astype(dtype)
+        u_s = jnp.where(jnp.isfinite(u), u / dc, u).astype(dtype)
+        q_norm = jnp.max(jnp.abs(q)) if m else jnp.asarray(0.0, dtype)
+        c_norm = jnp.max(jnp.abs(c)) if n else jnp.asarray(0.0, dtype)
+
+        c_us = c.astype(dtype)
+        q_us = q.astype(dtype)
+        l_us = l.astype(dtype)
+        u_us = u.astype(dtype)
+
+        # start at the projection of 0 onto the box, in the scaled space
+        x0 = jnp.clip(jnp.zeros(n, dtype), l_s, u_s)
+        y0 = jnp.zeros(m, dtype)
+
+        # primal weight: ratio of objective to rhs magnitude in the scaled
+        # space (PDLP's initialization) — battery LPs have tiny $-valued
+        # duals against large kW/kWh primals, so omega << 1 is typical
+        c2 = jnp.linalg.norm(c_s)
+        q2 = jnp.linalg.norm(q_s)
+        omega0 = jnp.where((c2 > 0) & (q2 > 0), c2 / jnp.maximum(q2, 1e-12),
+                           1.0).astype(dtype)
+        omega_lo = omega0 / 50.0
+        omega_hi = omega0 * 50.0
+
+        def check_scores(x, y):
+            return _kkt_terms(Kh, x, y, c_us, q_us, l_us, u_us, eq_mask, dr, dc,
+                              prec)
+
+        def mu_of(x, y):
+            pr, dr_, gp, po, do = check_scores(x, y)
+            denom = 1.0 + jnp.abs(po) + jnp.abs(do)
+            return jnp.sqrt(pr * pr + dr_ * dr_ + (gp / denom) ** 2), (pr, dr_, gp, po, do)
+
+        def cond(s: _State):
+            return (~jnp.all(s.converged)) & (s.total < opts.max_iters)
+
+        def body(s: _State):
+            (x, y), traj = jax.lax.scan(
+                functools.partial(one_iter, Kh=Kh, c=c_s, q=q_s, l=l_s, u=u_s,
+                                  eq_mask=eq_mask, omega=s.omega, eta=eta),
+                (s.x, s.y), None, length=opts.check_every)
+            xs, ys = traj
+            x_sum = s.x_sum + jnp.sum(xs, axis=0)
+            y_sum = s.y_sum + jnp.sum(ys, axis=0)
+            inner = s.inner + opts.check_every
+            total = s.total + opts.check_every
+            x_avg = x_sum / inner.astype(x.dtype)
+            y_avg = y_sum / inner.astype(x.dtype)
+
+            mu_cur, cur_terms = mu_of(x, y)
+            mu_avg, avg_terms = mu_of(x_avg, y_avg)
+            use_avg = mu_avg < mu_cur
+            x_cand = jnp.where(use_avg, x_avg, x)
+            y_cand = jnp.where(use_avg, y_avg, y)
+            mu_cand = jnp.minimum(mu_avg, mu_cur)
+            pr, dr_, gp, po, do = jax.tree.map(
+                lambda a, b: jnp.where(use_avg, a, b), avg_terms, cur_terms)
+
+            conv_now = _converged(pr, dr_, gp, po, do, q_norm, c_norm, opts)
+
+            do_restart = (
+                (mu_cand <= opts.beta_sufficient * s.mu_restart)
+                | ((mu_cand <= opts.beta_necessary * s.mu_restart) & (mu_cand > s.mu_prev))
+                | (inner >= opts.artificial_restart)
+            )
+            # primal weight update on restart
+            dx = jnp.linalg.norm(x_cand - s.x_restart)
+            dy = jnp.linalg.norm(y_cand - s.y_restart)
+            theta = opts.primal_weight_smoothing
+            new_omega = jnp.where(
+                (dx > 1e-10) & (dy > 1e-10),
+                jnp.exp(theta * jnp.log(dy / dx) + (1 - theta) * jnp.log(s.omega)),
+                s.omega,
+            )
+            # keep the weight near its problem-scaled initialization; the
+            # movement-ratio estimate can collapse the dual step otherwise
+            new_omega = jnp.clip(new_omega, omega_lo, omega_hi)
+            x_n = jnp.where(do_restart, x_cand, x)
+            y_n = jnp.where(do_restart, y_cand, y)
+
+            newly = conv_now & ~s.converged
+            return _State(
+                x=x_n, y=y_n,
+                x_sum=jnp.where(do_restart, jnp.zeros_like(x_sum), x_sum),
+                y_sum=jnp.where(do_restart, jnp.zeros_like(y_sum), y_sum),
+                inner=jnp.where(do_restart, 0, inner),
+                total=total,
+                omega=jnp.where(do_restart, new_omega, s.omega).astype(dtype),
+                x_restart=jnp.where(do_restart, x_cand, s.x_restart),
+                y_restart=jnp.where(do_restart, y_cand, s.y_restart),
+                mu_restart=jnp.where(do_restart, mu_cand, s.mu_restart),
+                mu_prev=mu_cand,
+                converged=s.converged | conv_now,
+                done_x=jnp.where(newly, x_cand, s.done_x),
+                done_y=jnp.where(newly, y_cand, s.done_y),
+                iters_at_conv=jnp.where(newly, total, s.iters_at_conv),
+            )
+
+        big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+        init = _State(
+            x=x0.astype(dtype), y=y0.astype(dtype),
+            x_sum=jnp.zeros(n, dtype), y_sum=jnp.zeros(m, dtype),
+            inner=jnp.asarray(0, jnp.int32), total=jnp.asarray(0, jnp.int32),
+            omega=omega0,
+            x_restart=x0.astype(dtype), y_restart=y0.astype(dtype),
+            mu_restart=big, mu_prev=big,
+            converged=jnp.asarray(False),
+            done_x=x0.astype(dtype), done_y=y0.astype(dtype),
+            iters_at_conv=jnp.asarray(opts.max_iters, jnp.int32),
+        )
+        final = jax.lax.while_loop(cond, body, init)
+        # if never converged, report last iterate
+        x_out = jnp.where(final.converged, final.done_x, final.x)
+        y_out = jnp.where(final.converged, final.done_y, final.y)
+        pr, dr_, gp, po, do = _kkt_terms(Kh, x_out, y_out, c_us, q_us, l_us, u_us,
+                                         eq_mask, dr, dc, prec)
+        return PDHGResult(
+            x=x_out * dc, y=y_out * dr, obj=po,
+            converged=final.converged,
+            iters=jnp.where(final.converged, final.iters_at_conv, final.total),
+            prim_res=pr, gap=gp,
+        )
+
+    return solve
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+class CompiledLPSolver:
+    """Preconditions an LP structure once, then solves (batches of) instances.
+
+    ``K`` (structure) is fixed; ``c, q, l, u`` may carry a leading batch
+    dimension.  The heavy per-iteration work is two dense matvecs which XLA
+    turns into MXU matmuls when batched.
+    """
+
+    def __init__(self, lp: LP, opts: Optional[PDHGOptions] = None):
+        self.opts = opts or PDHGOptions()
+        self.lp = lp
+        dtype = self.opts.dtype
+        d_r, d_c = ruiz_scaling(lp.K, self.opts.ruiz_iters)
+        Kh_sp = lp.K.multiply(d_r[:, None]).multiply(d_c[None, :])
+        self.Kh = jnp.asarray(Kh_sp.todense(), dtype)
+        self.dr = jnp.asarray(d_r, dtype)
+        self.dc = jnp.asarray(d_c, dtype)
+        # power iteration for ||Kh||_2
+        v = np.random.default_rng(0).standard_normal(lp.n)
+        v = jnp.asarray(v / np.linalg.norm(v), dtype)
+        Kh = self.Kh
+
+        prec = self.opts.precision
+
+        def piter(v, _):
+            w = jnp.matmul(Kh.T, jnp.matmul(Kh, v, precision=prec),
+                           precision=prec)
+            nw = jnp.linalg.norm(w)
+            return w / jnp.maximum(nw, 1e-30), nw
+
+        _, norms = jax.lax.scan(piter, v, None, length=self.opts.power_iters)
+        sigma_max = float(jnp.sqrt(norms[-1]))
+        self.eta = jnp.asarray(self.opts.step_size_safety / max(sigma_max, 1e-12), dtype)
+        self._solve = _make_solver(self.opts, lp.m, lp.n, lp.n_eq)
+        self._jit_single = jax.jit(self._solve)
+        self._jit_batch = jax.jit(
+            jax.vmap(self._solve,
+                     in_axes=(None, 0, 0, 0, 0, None, None, None)))
+
+    def _data(self, c, q, l, u):
+        lp = self.lp
+        c = lp.c if c is None else c
+        q = lp.q if q is None else q
+        l = lp.l if l is None else l
+        u = lp.u if u is None else u
+        return (jnp.asarray(c), jnp.asarray(q), jnp.asarray(l), jnp.asarray(u))
+
+    def solve(self, c=None, q=None, l=None, u=None) -> PDHGResult:
+        c, q, l, u = self._data(c, q, l, u)
+        if all(arr.ndim == 1 for arr in (c, q, l, u)):
+            return self._jit_single(self.Kh, c, q, l, u, self.dr, self.dc,
+                                    self.eta)
+        B = max(arr.shape[0] for arr in (c, q, l, u) if arr.ndim == 2)
+        c = jnp.broadcast_to(c, (B, self.lp.n)) if c.ndim == 1 else c
+        q = jnp.broadcast_to(q, (B, self.lp.m)) if q.ndim == 1 else q
+        l = jnp.broadcast_to(l, (B, self.lp.n)) if l.ndim == 1 else l
+        u = jnp.broadcast_to(u, (B, self.lp.n)) if u.ndim == 1 else u
+        return self._jit_batch(self.Kh, c, q, l, u, self.dr, self.dc,
+                               self.eta)
+
+
+def solve_lp(lp: LP, opts: Optional[PDHGOptions] = None) -> PDHGResult:
+    """One-shot convenience wrapper."""
+    return CompiledLPSolver(lp, opts).solve()

@@ -1,0 +1,40 @@
+"""Test configuration: force an 8-virtual-device CPU platform.
+
+Multi-chip sharding paths are exercised on a virtual CPU mesh
+(``xla_force_host_platform_device_count``) — the real TPU bench path is
+driven by ``bench.py`` / ``__graft_entry__.py`` instead.
+
+Note: this environment pre-imports jax at interpreter startup (sitecustomize
+registers the TPU backend), so setting JAX_PLATFORMS here is too late — we
+must force the platform through jax.config before any backend is touched.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption("--runslow", action="store_true", default=False,
+                     help="run tests marked slow")
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: mark test as slow to run")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    skip_slow = pytest.mark.skip(reason="need --runslow option to run")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip_slow)
