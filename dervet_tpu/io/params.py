@@ -1,0 +1,334 @@
+"""Model-parameters loader: reference-compatible inputs, case fan-out.
+
+Reads the reference's Model_Parameters CSV/JSON format (reference:
+dervet/DERVETParams.py:56-130 + the storagevet Params surface described in
+SURVEY.md §2.8), validates tags/keys against the compact schema, expands the
+sensitivity-analysis case matrix (independent cross-product + coupled
+columns), and loads referenced datasets (time series, monthly, yearly,
+tariff, cycle-life CSVs).
+
+Output is one :class:`CaseParams` per sensitivity case — a plain typed
+container the scenario runtime consumes.  No CVXPY, no class-level mutable
+registries: initialization is a pure function of the input file.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path, PureWindowsPath
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from .schema import DER_TAGS, SCHEMA, SINGLE_INSTANCE_TAGS
+from ..utils.errors import ModelParameterError, TellUser
+
+
+# ---------------------------------------------------------------------------
+# typed value conversion
+# ---------------------------------------------------------------------------
+
+_TRUE = {"1", "1.0", "yes", "y", "true"}
+_FALSE = {"0", "0.0", "no", "n", "false", "nan", "."}
+
+
+def convert_value(raw: Any, declared: str, key: str = "") -> Any:
+    """Convert a raw cell (string) according to the schema's declared type."""
+    s = str(raw).strip()
+    if declared == "float":
+        return float(s)
+    if declared == "int":
+        return int(float(s))
+    if declared == "bool":
+        low = s.lower()
+        if low in _TRUE:
+            return True
+        if low in _FALSE:
+            return False
+        raise ModelParameterError(f"cannot parse bool {raw!r} for {key}")
+    if declared == "Period":
+        return int(float(s))
+    if declared == "list/int":
+        return [int(float(p)) for p in s.replace("[", "").replace("]", "").split(",")]
+    if declared == "string/int":
+        try:
+            return int(float(s))
+        except ValueError:
+            return s
+    # string (includes filenames)
+    return s
+
+
+def normalize_path(raw: str, base_path: Path) -> Path:
+    """Resolve a (possibly Windows-style, possibly relative) file reference."""
+    p = PureWindowsPath(str(raw).strip())
+    parts = [x for x in p.parts if x not in (".", "\\", "/")]
+    candidate = Path(*parts) if parts else Path(str(raw))
+    if candidate.is_absolute() and candidate.exists():
+        return candidate
+    for root in (base_path, Path.cwd()):
+        full = root / candidate
+        if full.exists():
+            return full
+    raise ModelParameterError(f"referenced file not found: {raw!r} "
+                              f"(searched under {base_path} and cwd)")
+
+
+# ---------------------------------------------------------------------------
+# normalized input rows
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class InputRow:
+    tag: str
+    id: str
+    key: str
+    value: Any          # raw string
+    type: str
+    sensitivity: Optional[List[Any]] = None   # parsed list of raw strings
+    coupled: Optional[str] = None             # coupling group label
+
+
+def _read_csv_rows(path: Path) -> List[InputRow]:
+    df = pd.read_csv(path, dtype=str)
+    value_col = "Optimization Value" if "Optimization Value" in df.columns else "Value"
+    has_id = "ID" in df.columns
+    rows = []
+    active_pairs = set()
+    for _, r in df.iterrows():
+        tag = str(r.get("Tag", "")).strip()
+        key = r.get("Key")
+        if not tag or tag == "Tag" or pd.isna(key):
+            continue
+        rid = str(r["ID"]).strip() if has_id and not pd.isna(r.get("ID")) else ""
+        if rid == ".":
+            rid = ""
+        active = str(r.get("Active", "")).strip().lower()
+        if active in ("yes", "y", "1"):
+            active_pairs.add((tag, rid))
+        sens_active = str(r.get("Sensitivity Analysis", "")).strip().lower() == "yes"
+        sens = None
+        if sens_active and not pd.isna(r.get("Sensitivity Parameters")):
+            sens = [p.strip() for p in
+                    str(r["Sensitivity Parameters"]).replace("[", "").replace("]", "").split(",")]
+        coupled = r.get("Coupled")
+        coupled = None if (coupled is None or pd.isna(coupled)
+                           or str(coupled).strip() in ("None", "")) else str(coupled).strip()
+        rows.append(InputRow(tag=tag, id=rid, key=str(key).strip(),
+                             value=r[value_col], type=str(r.get("Type", "string")).strip(),
+                             sensitivity=sens, coupled=coupled))
+    return [r for r in rows if (r.tag, r.id) in active_pairs]
+
+
+def _read_json_rows(path: Path) -> List[InputRow]:
+    tree = json.loads(path.read_text())
+    tags = tree.get("tags", tree)
+    rows: List[InputRow] = []
+    for tag, instances in tags.items():
+        for rid, inst in instances.items():
+            active = str(inst.get("active", "no")).strip().lower()
+            if active not in ("yes", "y", "1"):
+                continue
+            rid = "" if rid in (".", "None") else str(rid)
+            for key, attrs in inst.get("keys", {}).items():
+                sens = attrs.get("sensitivity", {})
+                sens_list = None
+                coupled = None
+                if isinstance(sens, dict) and str(sens.get("active", "no")).lower() == "yes":
+                    sens_list = [p.strip() for p in
+                                 str(sens.get("value", "")).replace("[", "").replace("]", "").split(",")]
+                    coupled = sens.get("coupled")
+                    coupled = None if coupled in (None, "None", "") else str(coupled)
+                rows.append(InputRow(tag=tag, id=rid, key=key,
+                                     value=attrs.get("opt_value", attrs.get("value")),
+                                     type=str(attrs.get("type", SCHEMA.get(tag, {}).get(key, "string"))),
+                                     sensitivity=sens_list, coupled=coupled))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# datasets
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Datasets:
+    """Referenced CSV data, normalized to hour-beginning indices."""
+    time_series: Optional[pd.DataFrame] = None
+    monthly: Optional[pd.DataFrame] = None
+    yearly: Optional[pd.DataFrame] = None
+    tariff: Optional[pd.DataFrame] = None
+    cycle_life: Optional[pd.DataFrame] = None
+
+
+def load_time_series(path: Path, dt_hours: float) -> pd.DataFrame:
+    df = pd.read_csv(path)
+    dt_col = df.columns[0]
+    idx = pd.to_datetime(df[dt_col], format="mixed", dayfirst=False)
+    # the reference's time series are hour-ENDING; convert to hour-beginning
+    df = df.drop(columns=[dt_col])
+    df.index = idx - pd.Timedelta(hours=dt_hours)
+    df.index.name = "Start Datetime (hb)"
+    return df
+
+
+def load_monthly(path: Path) -> pd.DataFrame:
+    df = pd.read_csv(path)
+    df = df.set_index(["Year", "Month"])
+    return df
+
+
+def load_yearly(path: Path) -> pd.DataFrame:
+    df = pd.read_csv(path)
+    return df.set_index("Year")
+
+
+def load_tariff(path: Path) -> pd.DataFrame:
+    df = pd.read_csv(path)
+    return df.set_index("Billing Period")
+
+
+# ---------------------------------------------------------------------------
+# per-case container
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class CaseParams:
+    case_id: int
+    scenario: Dict[str, Any]
+    finance: Dict[str, Any]
+    results: Dict[str, Any]
+    ders: List[Tuple[str, str, Dict[str, Any]]]       # (tag, id, keys)
+    streams: Dict[str, Dict[str, Any]]                # tag -> keys
+    datasets: Datasets
+    overrides: Dict[Tuple[str, str, str], Any] = dataclasses.field(default_factory=dict)
+
+
+class Params:
+    """Reference-compatible initializer: one CaseParams per sensitivity case.
+
+    Mirrors the surface of ``storagevet.Params.initialize`` +
+    ``ParamsDER.initialize`` (SURVEY.md §2.2/§3.5) without class-level state.
+    """
+
+    @classmethod
+    def initialize(cls, filename, base_path=None, verbose: bool = False
+                   ) -> Dict[int, CaseParams]:
+        path = Path(filename)
+        if not path.exists():
+            raise ModelParameterError(f"model parameters file not found: {filename}")
+        base = Path(base_path) if base_path else path.parent
+        if path.suffix.lower() == ".json":
+            rows = _read_json_rows(path)
+        else:
+            rows = _read_csv_rows(path)
+        if not rows:
+            raise ModelParameterError(f"no active tags found in {filename}")
+        cls._validate(rows)
+        case_defs, sens_df = cls._case_definitions(rows)
+        instances: Dict[int, CaseParams] = {}
+        for case_id, overrides in enumerate(case_defs):
+            instances[case_id] = cls._build_case(case_id, rows, overrides, base, verbose)
+        # attach the sensitivity summary frame to every instance set
+        for inst in instances.values():
+            inst.sensitivity_df = sens_df  # type: ignore[attr-defined]
+        return instances
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _validate(rows: List[InputRow]) -> None:
+        for r in rows:
+            if r.tag not in SCHEMA:
+                raise ModelParameterError(f"unknown tag {r.tag!r}")
+            if r.key not in SCHEMA[r.tag]:
+                TellUser.warning(f"unknown key {r.tag}.{r.key} — ignoring schema type")
+        seen_single = {}
+        for r in rows:
+            if r.tag in SINGLE_INSTANCE_TAGS:
+                seen_single.setdefault(r.tag, set()).add(r.id)
+        for tag, ids in seen_single.items():
+            if len(ids) > 1:
+                raise ModelParameterError(f"tag {tag} allows only one instance, got ids {ids}")
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _case_definitions(rows: List[InputRow]):
+        """Cross-product of independent sensitivity lists; coupled groups
+        vary in lockstep (reference: test_1params.py:51-62 semantics)."""
+        sens_rows = [r for r in rows if r.sensitivity]
+        if not sens_rows:
+            return [dict()], pd.DataFrame()
+        groups: Dict[str, List[InputRow]] = {}
+        for i, r in enumerate(sens_rows):
+            label = r.coupled if r.coupled else f"__solo_{i}"
+            groups.setdefault(label, []).append(r)
+        axes = []
+        for label, grp in groups.items():
+            n_vals = {len(r.sensitivity) for r in grp}
+            if len(n_vals) > 1:
+                raise ModelParameterError(
+                    f"coupled sensitivity lists must have equal length, group {label}: "
+                    f"{[(r.tag, r.key, len(r.sensitivity)) for r in grp]}")
+            n = n_vals.pop()
+            axes.append([(grp, j) for j in range(n)])
+        import itertools
+        case_defs = []
+        records = []
+        for combo in itertools.product(*axes):
+            overrides = {}
+            rec = {}
+            for grp, j in combo:
+                for r in grp:
+                    overrides[(r.tag, r.id, r.key)] = r.sensitivity[j]
+                    rec[f"{r.tag}/{r.key}"] = r.sensitivity[j]
+            case_defs.append(overrides)
+            records.append(rec)
+        return case_defs, pd.DataFrame(records)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def _build_case(cls, case_id, rows, overrides, base, verbose) -> CaseParams:
+        tag_maps: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        for r in rows:
+            raw = overrides.get((r.tag, r.id, r.key), r.value)
+            declared = SCHEMA.get(r.tag, {}).get(r.key, r.type or "string")
+            try:
+                val = convert_value(raw, declared, key=f"{r.tag}.{r.key}")
+            except (ValueError, TypeError) as e:
+                raise ModelParameterError(
+                    f"bad value {raw!r} for {r.tag}.{r.key} (type {declared}): {e}")
+            tag_maps.setdefault((r.tag, r.id), {})[r.key] = val
+
+        scenario = next((v for (t, _), v in tag_maps.items() if t == "Scenario"), {})
+        finance = next((v for (t, _), v in tag_maps.items() if t == "Finance"), {})
+        results = next((v for (t, _), v in tag_maps.items() if t == "Results"), {})
+        if not scenario:
+            raise ModelParameterError("Scenario tag is required")
+        if not finance:
+            raise ModelParameterError("Finance tag is required")
+        ders = [(t, i, v) for (t, i), v in tag_maps.items() if t in DER_TAGS]
+        streams = {t: v for (t, _), v in tag_maps.items()
+                   if t in SINGLE_INSTANCE_TAGS and t not in ("Scenario", "Finance", "Results")}
+
+        datasets = Datasets()
+        dt = float(scenario.get("dt", 1))
+        if scenario.get("time_series_filename"):
+            datasets.time_series = load_time_series(
+                normalize_path(scenario["time_series_filename"], base), dt)
+        if scenario.get("monthly_data_filename"):
+            datasets.monthly = load_monthly(
+                normalize_path(scenario["monthly_data_filename"], base))
+        if finance.get("yearly_data_filename"):
+            datasets.yearly = load_yearly(
+                normalize_path(finance["yearly_data_filename"], base))
+        if finance.get("customer_tariff_filename"):
+            datasets.tariff = load_tariff(
+                normalize_path(finance["customer_tariff_filename"], base))
+        for tag, _, keys in ders:
+            if tag == "Battery" and keys.get("incl_cycle_degrade") and \
+                    keys.get("cycle_life_filename"):
+                datasets.cycle_life = pd.read_csv(
+                    normalize_path(keys["cycle_life_filename"], base))
+        return CaseParams(case_id=case_id, scenario=scenario, finance=finance,
+                          results=results, ders=ders, streams=streams,
+                          datasets=datasets, overrides=dict(overrides))

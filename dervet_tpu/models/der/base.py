@@ -1,0 +1,88 @@
+"""DER base class: the component contract for the LP-block architecture.
+
+Replaces the reference's CVXPY-variable DER base
+(storagevet.Technology.DistributedEnergyResource.DER surface, SURVEY.md
+§2.8): instead of returning CVXPY expression trees from
+``initialize_variables``/``constraints``/``objective_function``, each DER
+emits named variable blocks, structured constraint rows, and linear cost
+vectors into an :class:`~dervet_tpu.ops.lp.LPBuilder`, once per
+optimization window.  Dispatch results come back as named slices of the
+batched solution tensor.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+import pandas as pd
+
+from ...ops.lp import LPBuilder
+
+
+class DER:
+    """Base distributed energy resource."""
+
+    technology_type = "DER"
+
+    def __init__(self, tag: str, der_id: str, keys: Dict, scenario: Dict):
+        self.tag = tag
+        self.id = der_id
+        self.name = str(keys.get("name", tag))
+        self.dt = float(scenario.get("dt", 1))
+        self.keys = keys
+        # full-year dispatch results, filled by the scenario loop
+        self.variables_df: Optional[pd.DataFrame] = None
+
+    # ---------- identity / column naming (matches reference outputs) ----
+    @property
+    def unique_tech_id(self) -> str:
+        return f"{self.tag.upper()}: {self.name}"
+
+    # ---------- LP assembly --------------------------------------------
+    def vname(self, var: str) -> str:
+        return f"{self.tag}-{self.id or '1'}/{var}"
+
+    def build(self, b: LPBuilder, T: int, data: Dict) -> None:
+        """Register variables/constraints/costs for a T-step window.
+
+        ``data`` carries per-window arrays (prices, profiles) and scalars
+        (annuity_scalar).  Implementations must create identical structure
+        for equal T so windows can share one compiled solver.
+        """
+        raise NotImplementedError
+
+    # power contributions to the POI balance, as (varname, sign) pairs
+    def generation_vars(self):
+        return []
+
+    def load_vars(self):
+        return []
+
+    # state of energy contribution (varname) or None
+    def soe_var(self) -> Optional[str]:
+        return None
+
+    # ---------- results -------------------------------------------------
+    def store_dispatch(self, index: pd.DatetimeIndex, values: Dict[str, np.ndarray]):
+        """Stash full-year dispatch arrays (keyed by short var name)."""
+        self.variables_df = pd.DataFrame(values, index=index)
+
+    def timeseries_report(self) -> pd.DataFrame:
+        return pd.DataFrame(index=self.variables_df.index)
+
+    def monthly_report(self) -> pd.DataFrame:
+        return pd.DataFrame()
+
+    def proforma_report(self, opt_years, results: pd.DataFrame) -> Optional[pd.DataFrame]:
+        return None
+
+    def get_capex(self) -> float:
+        return 0.0
+
+    def sizing_summary(self) -> Dict:
+        return {}
+
+    # operational window (DERExtension surface: operation_year gating)
+    def operational(self, year: int) -> bool:
+        op_year = int(self.keys.get("operation_year", 0) or 0)
+        return year >= op_year if op_year else True

@@ -1,0 +1,56 @@
+"""Logging + error types (reference surface: storagevet.ErrorHandling,
+re-exported exceptions used across dervet — SURVEY.md §2.8)."""
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+
+
+class ModelParameterError(Exception):
+    """Bad model-parameters input (tag/key/value/combination)."""
+
+
+class ParameterError(Exception):
+    """Invalid parameter combination discovered after load."""
+
+
+class TimeseriesDataError(Exception):
+    """Referenced time-series data is missing or inconsistent."""
+
+
+class SolverError(Exception):
+    """Dispatch optimization failed (non-convergence / infeasibility)."""
+
+
+class TellUser:
+    """Static logger facade, mirrors the reference's TellUser usage."""
+
+    logger = logging.getLogger("dervet_tpu")
+    if not logger.handlers:
+        _h = logging.StreamHandler()
+        _h.setFormatter(logging.Formatter("%(levelname)s: %(message)s"))
+        logger.addHandler(_h)
+        logger.setLevel(logging.INFO)
+
+    @classmethod
+    def attach_file(cls, results_dir: Path, name: str = "dervet_tpu.log") -> None:
+        results_dir.mkdir(parents=True, exist_ok=True)
+        fh = logging.FileHandler(results_dir / name)
+        fh.setFormatter(logging.Formatter("%(asctime)s %(levelname)s: %(message)s"))
+        cls.logger.addHandler(fh)
+
+    @classmethod
+    def debug(cls, msg: str) -> None:
+        cls.logger.debug(msg)
+
+    @classmethod
+    def info(cls, msg: str) -> None:
+        cls.logger.info(msg)
+
+    @classmethod
+    def warning(cls, msg: str) -> None:
+        cls.logger.warning(msg)
+
+    @classmethod
+    def error(cls, msg: str) -> None:
+        cls.logger.error(msg)
