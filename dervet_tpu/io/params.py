@@ -50,27 +50,74 @@ def convert_value(raw: Any, declared: str, key: str = "") -> Any:
     if declared == "Period":
         return int(float(s))
     if declared == "list/int":
-        return [int(float(p)) for p in s.replace("[", "").replace("]", "").split(",")]
+        # reference inputs separate list items with commas OR whitespace
+        parts = s.replace("[", "").replace("]", "").replace(",", " ").split()
+        return [int(float(p)) for p in parts]
     if declared == "string/int":
         try:
             return int(float(s))
+        except ValueError:
+            return s
+    if declared == "string/float":
+        try:
+            return float(s)
         except ValueError:
             return s
     # string (includes filenames)
     return s
 
 
+def _find_case_insensitive(root: Path, rel: Path) -> Optional[Path]:
+    """Resolve ``root/rel`` tolerating per-component case mismatches.
+
+    Reference inputs were authored on Windows (case-insensitive FS): e.g. the
+    canonical template references ``monthly_Data.csv`` while the file on disk
+    is ``monthly_data.csv``."""
+    cur = root
+    for part in rel.parts:
+        nxt = cur / part
+        if not nxt.exists():
+            if not cur.is_dir():
+                return None
+            match = next((child for child in cur.iterdir()
+                          if child.name.lower() == part.lower()), None)
+            if match is None:
+                return None
+            nxt = match
+        cur = nxt
+    return cur
+
+
 def normalize_path(raw: str, base_path: Path) -> Path:
     """Resolve a (possibly Windows-style, possibly relative) file reference."""
-    p = PureWindowsPath(str(raw).strip())
+    s = str(raw).strip()
+    direct = Path(s)
+    if direct.is_absolute():
+        if direct.exists():
+            return direct
+        found = _find_case_insensitive(Path(direct.anchor), direct.relative_to(direct.anchor))
+        if found is not None:
+            return found
+    # windows-style normalization for strings like '.\\data\\x.csv'
+    p = PureWindowsPath(s)
     parts = [x for x in p.parts if x not in (".", "\\", "/")]
-    candidate = Path(*parts) if parts else Path(str(raw))
-    if candidate.is_absolute() and candidate.exists():
-        return candidate
+    candidate = Path(*parts) if parts else Path(s)
     for root in (base_path, Path.cwd()):
         full = root / candidate
         if full.exists():
             return full
+        found = _find_case_insensitive(root, candidate)
+        if found is not None:
+            return found
+    # last resort: inputs that reference data under the (absent) storagevet
+    # submodule, e.g. '.\\dervet\\storagevet\\Data\\x.csv'; the same files
+    # ship at '<root>/data/x.csv' in the snapshot.  Restricted to paths that
+    # actually point into storagevet so a typo elsewhere still raises.
+    if "storagevet" in s.lower():
+        for root in (base_path, Path.cwd()):
+            found = _find_case_insensitive(root, Path("data") / candidate.name)
+            if found is not None:
+                return found
     raise ModelParameterError(f"referenced file not found: {raw!r} "
                               f"(searched under {base_path} and cwd)")
 
@@ -202,6 +249,9 @@ class CaseParams:
     streams: Dict[str, Dict[str, Any]]                # tag -> keys
     datasets: Datasets
     overrides: Dict[Tuple[str, str, str], Any] = dataclasses.field(default_factory=dict)
+    sensitivity_df: pd.DataFrame = dataclasses.field(default_factory=pd.DataFrame)
+    # CBA "Evaluation" re-pricing values keyed like overrides (tag, id, key)
+    cba_overrides: Dict[Tuple[str, str, str], Any] = dataclasses.field(default_factory=dict)
 
 
 class Params:
@@ -231,7 +281,7 @@ class Params:
             instances[case_id] = cls._build_case(case_id, rows, overrides, base, verbose)
         # attach the sensitivity summary frame to every instance set
         for inst in instances.values():
-            inst.sensitivity_df = sens_df  # type: ignore[attr-defined]
+            inst.sensitivity_df = sens_df
         return instances
 
     # ------------------------------------------------------------------

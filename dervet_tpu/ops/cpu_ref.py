@@ -43,7 +43,9 @@ def solve_lp_cpu(lp: LP, c=None, q=None, l=None, u=None) -> CPUResult:
 
 def solve_lp_cpu_batch(lp: LP, c_b=None, q_b=None, l_b=None, u_b=None):
     """Serial loop over a batch — reference semantics, used only in tests."""
-    B = max(arr.shape[0] for arr in (c_b, q_b, l_b, u_b) if arr is not None)
+    batched = [arr.shape[0] for arr in (c_b, q_b, l_b, u_b)
+               if arr is not None and arr.ndim == 2]
+    B = max(batched) if batched else 1
 
     def pick(arr, i, default):
         if arr is None:

@@ -352,7 +352,12 @@ class CompiledLPSolver:
         if all(arr.ndim == 1 for arr in (c, q, l, u)):
             return self._jit_single(self.Kh, c, q, l, u, self.dr, self.dc,
                                     self.eta)
-        B = max(arr.shape[0] for arr in (c, q, l, u) if arr.ndim == 2)
+        if any(arr.ndim not in (1, 2) for arr in (c, q, l, u)):
+            raise ValueError("solve() inputs must be 1-D (shared) or 2-D (batched)")
+        sizes = {arr.shape[0] for arr in (c, q, l, u) if arr.ndim == 2}
+        if len(sizes) > 1:
+            raise ValueError(f"inconsistent batch sizes in solve(): {sorted(sizes)}")
+        B = sizes.pop()
         c = jnp.broadcast_to(c, (B, self.lp.n)) if c.ndim == 1 else c
         q = jnp.broadcast_to(q, (B, self.lp.m)) if q.ndim == 1 else q
         l = jnp.broadcast_to(l, (B, self.lp.n)) if l.ndim == 1 else l

@@ -1,0 +1,98 @@
+"""Params loader regression: every reference input must load (or fail with
+the reference's error semantics).
+
+Reference inputs are the spec (VERDICT r1 item 3): this sweeps every
+model-parameters CSV in the reference snapshot through ``Params.initialize``.
+Files whose referenced datasets are absent from the snapshot (large 5-min
+blobs listed in /root/reference/.MISSING_LARGE_BLOBS, paths under the
+never-checked-out storagevet submodule, .xlsx inputs) must raise
+``ModelParameterError`` — the reference's own failure mode for a missing
+referenced file (dervet/DERVETParams.py:93-130).
+"""
+import glob
+from pathlib import Path
+
+import pytest
+
+from dervet_tpu.io.params import Params, convert_value, normalize_path
+from dervet_tpu.utils.errors import ModelParameterError
+
+REF = Path("/root/reference")
+
+# inputs whose referenced data files do not exist anywhere in the snapshot
+# (or that only disabled xtest_ reference tests consume)
+KNOWN_UNLOADABLE = {
+    "004-cba_valuation_coupled_dt.csv",          # 000-011-timeseries_5min_2017.csv missing
+    "Model_Parameters_Template_DER_PoSD.csv",    # .\Testing\... datasets absent
+    "Model_Parameters_Template_DER_PoSD_deferral.csv",
+    "Model_Parameters_Template_DER_PoSD_service_error.csv",
+    "Model_Parameters_Template_ENEA_S1_8_12_UC1_DAETS.csv",
+    "Model_Parameters_Template_ENEA_S1_8_12_UC1_DAETS_doesnt_reach_eol_during_opt.csv",
+    "shortest_lifetime_linear_salvage.csv",      # swapped cols; only xtest_ uses it
+    "017-bat_timeseries_dt_sensitivity_couples.csv",  # .xlsx input absent
+    "018-DA_battery_month_5min.csv",             # .MISSING_LARGE_BLOBS
+    "020-coupled_dt_timseries_error.csv",        # .MISSING_LARGE_BLOBS
+}
+
+ALL_INPUTS = sorted(
+    set(glob.glob(str(REF / "test/**/model_params/*.csv"), recursive=True))
+    | {str(REF / "Model_Parameters_Template_DER.csv")}
+)
+
+
+@pytest.mark.parametrize("path", ALL_INPUTS, ids=lambda p: Path(p).name)
+def test_reference_input_loads(path):
+    name = Path(path).name
+    if name in KNOWN_UNLOADABLE:
+        with pytest.raises(ModelParameterError):
+            Params.initialize(path, base_path=REF)
+        return
+    cases = Params.initialize(path, base_path=REF)
+    assert len(cases) >= 1
+    case = cases[0]
+    assert case.scenario and case.finance
+
+
+def test_canonical_template_monthly_data_case_mismatch():
+    """The canonical template references 'monthly_Data.csv'; on-disk file is
+    'monthly_data.csv' — resolution must be case-insensitive (ADVICE r1)."""
+    cases = Params.initialize(REF / "Model_Parameters_Template_DER.csv", base_path=REF)
+    assert cases[0].datasets.monthly is not None
+
+
+def test_posix_absolute_path(tmp_path):
+    f = tmp_path / "ts.csv"
+    f.write_text("a,b\n1,2\n")
+    assert normalize_path(str(f), tmp_path) == f
+
+
+def test_sensitivity_fanout():
+    """009-bat_energy_sensitivity sweeps ene_max_rated -> multiple cases
+    (reference: test_1params.py:51-62 semantics)."""
+    path = REF / "test/test_storagevet_features/model_params/009-bat_energy_sensitivity.csv"
+    cases = Params.initialize(path, base_path=REF)
+    assert len(cases) > 1
+    vals = set()
+    for c in cases.values():
+        bat = next(keys for tag, _, keys in c.ders if tag == "Battery")
+        vals.add(bat["ene_max_rated"])
+    assert len(vals) == len(cases)
+    assert not cases[0].sensitivity_df.empty
+
+
+def test_multiyear_opt_years_whitespace_list():
+    path = REF / "test/test_storagevet_features/model_params/007-nsr_battery_multiyr.csv"
+    cases = Params.initialize(path, base_path=REF)
+    assert cases[0].scenario["opt_years"] == [2017, 2018]
+
+
+def test_convert_value_types():
+    assert convert_value("1.5", "float") == 1.5
+    assert convert_value("2017, 2018", "list/int") == [2017, 2018]
+    assert convert_value("2017 2018", "list/int") == [2017, 2018]
+    assert convert_value("month", "string/int") == "month"
+    assert convert_value("744", "string/int") == 744
+    assert convert_value("linear salvage value", "string/float") == "linear salvage value"
+    assert convert_value("500", "string/float") == 500.0
+    assert convert_value("yes", "bool") is True
+    assert convert_value("nan", "bool") is False
