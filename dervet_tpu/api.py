@@ -1,0 +1,42 @@
+"""Top-level API: the DERVET class and case pipeline.
+
+Re-designs dervet/DERVET.py:50-90 (reference: builds Params cases + Result
+registry, runs every case through the 5-step scenario pipeline, times the
+run).  ``DERVET(path).solve()`` returns the Results registry; the CLI in
+``dervet_tpu.__main__`` wraps it.
+"""
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Dict, Optional
+
+from .io.params import CaseParams, Params
+from .scenario.scenario import MicrogridScenario
+from .utils.errors import TellUser
+
+
+class DERVET:
+    """One model-parameters file -> N sensitivity cases -> results."""
+
+    def __init__(self, model_parameters_path, verbose: bool = False,
+                 base_path=None):
+        self.start_time = time.time()
+        self.verbose = verbose
+        self.cases: Dict[int, CaseParams] = Params.initialize(
+            model_parameters_path, base_path=base_path, verbose=verbose)
+        TellUser.info(f"Initialized {len(self.cases)} case(s) from "
+                      f"{model_parameters_path}")
+
+    def solve(self, backend: str = "jax", solver_opts=None):
+        from .results.result import Result
+        results = Result.initialize(self.cases)
+        for key, case in self.cases.items():
+            TellUser.info(f"Running case {key}...")
+            scenario = MicrogridScenario(case)
+            scenario.optimize_problem_loop(backend=backend,
+                                           solver_opts=solver_opts)
+            results.add_instance(key, scenario)
+        results.sensitivity_summary()
+        TellUser.info(f"DERVET runtime: {time.time() - self.start_time:.2f} s")
+        return results

@@ -1,0 +1,2 @@
+"""Financial post-processing (CBA/proforma/NPV)."""
+from .cba import CostBenefitAnalysis

@@ -1,0 +1,348 @@
+"""Financial post-processing: proforma, NPV, payback, cost-benefit, taxes.
+
+Re-implements dervet/CBA.py (CostBenefitAnalysis) + the storagevet
+Financial surface (SURVEY.md §2.6/§2.8) as pure pandas/numpy
+post-processing of the dispatch tensors:
+
+* proforma assembly: one column per cost/benefit stream, rows CAPEX Year +
+  every project year (start_year..end_year), non-optimized years filled
+  forward from the nearest optimized year
+* capital costs land in the CAPEX Year row (construction-year handling,
+  reference CBA.py:392-407)
+* salvage value / decommissioning at end of analysis (CBA.py:409-438)
+* MACRS depreciation + state/federal taxes (CBA.py:440-477) or economic
+  carrying cost substitution (ecc_mode)
+* NPV by column, payback + discounted payback, IRR, benefit-cost ratio
+  (CBA.py:479-523)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from ..utils.errors import ParameterError, TellUser
+
+# MACRS half-year convention depreciation schedules (% of basis per year),
+# standard IRS tables (reference carries the same tables, CBA.py:81-92)
+MACRS_TABLES: Dict[int, List[float]] = {
+    3: [33.33, 44.45, 14.81, 7.41],
+    5: [20.0, 32.0, 19.2, 11.52, 11.52, 5.76],
+    7: [14.29, 24.49, 17.49, 12.49, 8.93, 8.92, 8.93, 4.46],
+    10: [10.0, 18.0, 14.4, 11.52, 9.22, 7.37, 6.55, 6.55, 6.56, 6.55, 3.28],
+    15: [5.0, 9.5, 8.55, 7.7, 6.93, 6.23, 5.9, 5.9, 5.91, 5.9, 5.91, 5.9,
+         5.91, 5.9, 5.91, 2.95],
+    20: [3.75, 7.219, 6.677, 6.177, 5.713, 5.285, 4.888, 4.522, 4.462, 4.461,
+         4.462, 4.461, 4.462, 4.461, 4.462, 4.461, 4.462, 4.461, 4.462,
+         4.461, 2.231],
+}
+
+CAPEX_ROW = "CAPEX Year"
+
+
+def npv_series(rate: float, values: np.ndarray) -> float:
+    """Present value of values[0..n] where values[k] occurs at year k
+    (k=0 not discounted) — numpy-financial npv semantics (CBA.py:212)."""
+    return float(sum(v / (1.0 + rate) ** k for k, v in enumerate(values)))
+
+
+def irr(values: np.ndarray, lo=-0.99, hi=10.0, tol=1e-10) -> float:
+    """Internal rate of return by bisection (replaces removed np.irr)."""
+    def f(r):
+        return sum(v / (1.0 + r) ** k for k, v in enumerate(values))
+    flo, fhi = f(lo), f(hi)
+    if flo * fhi > 0:
+        return float("nan")
+    for _ in range(200):
+        mid = 0.5 * (lo + hi)
+        fm = f(mid)
+        if abs(fm) < tol:
+            return mid
+        if flo * fm < 0:
+            hi, fhi = mid, fm
+        else:
+            lo, flo = mid, fm
+    return 0.5 * (lo + hi)
+
+
+class CostBenefitAnalysis:
+    """Project-lifetime economics for one scenario case."""
+
+    def __init__(self, finance: Dict, start_year: int, end_year: int,
+                 opt_years: List[int], dt: float = 1.0):
+        self.finance = finance
+        g = lambda k, d=0.0: float(finance.get(k, d) or 0.0)
+        self.inflation_rate = g("inflation_rate") / 100.0
+        self.npv_discount_rate = g("npv_discount_rate") / 100.0
+        self.federal_tax_rate = g("federal_tax_rate") / 100.0
+        self.state_tax_rate = g("state_tax_rate") / 100.0
+        self.property_tax_rate = g("property_tax_rate") / 100.0
+        self.analysis_horizon_mode = int(g("analysis_horizon_mode", 1) or 1)
+        self.ecc_mode = bool(finance.get("ecc_mode", False))
+        self.external_incentives = bool(finance.get("external_incentives", False))
+        self.start_year = int(start_year)
+        self.end_year = int(end_year)
+        self.opt_years = sorted(int(y) for y in opt_years)
+        self.dt = dt
+        self.proforma: Optional[pd.DataFrame] = None
+        self.npv: Optional[pd.DataFrame] = None
+        self.payback: Optional[pd.DataFrame] = None
+        self.cost_benefit: Optional[pd.DataFrame] = None
+        self.tax_breakdown: Optional[pd.DataFrame] = None
+
+    # ------------------------------------------------------------------
+    def find_end_year(self, der_list) -> int:
+        """Analysis-horizon modes (reference CBA.py:94-130): 1 = user,
+        2 = shortest DER lifetime, 3 = longest DER lifetime."""
+        if self.analysis_horizon_mode == 1:
+            return self.end_year
+        lifetimes = []
+        for der in der_list:
+            lt = int(der.keys.get("expected_lifetime", 0) or 0)
+            op = int(der.keys.get("operation_year", self.start_year)
+                     or self.start_year)
+            if lt:
+                lifetimes.append(op + lt - 1)
+        if not lifetimes:
+            return self.end_year
+        return (min(lifetimes) if self.analysis_horizon_mode == 2
+                else max(lifetimes))
+
+    def annuity_scalar(self, opt_years: List[int]) -> float:
+        """Scalar converting one optimized year's cost to lifetime present
+        value (reference CBA.py:190-213) — used in sizing objectives."""
+        n_years = self.end_year - self.start_year + 1
+        dollars = np.ones(n_years)
+        for k in range(1, n_years):
+            dollars[k] = dollars[k - 1] * (1 + self.inflation_rate)
+        pv = sum(d / (1 + self.npv_discount_rate) ** (k + 1)
+                 for k, d in enumerate(dollars))
+        return float(pv)
+
+    # ------------------------------------------------------------------
+    def calculate(self, ders, value_streams: Dict, results: pd.DataFrame,
+                  opt_years: List[int]) -> None:
+        self.proforma = self.proforma_report(ders, value_streams, results,
+                                             opt_years)
+        self.npv = self.npv_report(self.proforma)
+        self.payback = self.payback_report(self.proforma)
+        self.cost_benefit = self.cost_benefit_report(self.proforma)
+
+    # ------------------------------------------------------------------
+    def proforma_report(self, ders, value_streams: Dict,
+                        results: pd.DataFrame, opt_years: List[int]
+                        ) -> pd.DataFrame:
+        years = list(range(self.start_year, self.end_year + 1))
+        index = [CAPEX_ROW] + years
+        proforma = pd.DataFrame(index=index)
+
+        for der in ders:
+            cols = self._der_columns(der, opt_years, results)
+            for name, series in cols.items():
+                proforma[name] = series
+
+        for vs in value_streams.values():
+            df = vs.proforma_report(opt_years, None, results)
+            if df is None:
+                continue
+            for name in df.columns:
+                col = pd.Series(0.0, index=index, dtype=float)
+                for per, val in df[name].items():
+                    yr = per.year if hasattr(per, "year") else int(per)
+                    if yr in col.index:
+                        col[yr] = val
+                proforma[name] = col
+
+        proforma = self._fill_forward(proforma, opt_years)
+        if self.ecc_mode:
+            TellUser.warning("ecc_mode proforma substitution not yet "
+                             "implemented; using direct capital costs")
+        taxes = self.calculate_taxes(proforma, ders)
+        if taxes is not None:
+            proforma["Overall Tax Burden"] = taxes
+        proforma["Yearly Net Value"] = proforma.sum(axis=1)
+        return proforma
+
+    def _der_columns(self, der, opt_years, results) -> Dict[str, pd.Series]:
+        years = [CAPEX_ROW] + list(range(self.start_year, self.end_year + 1))
+        cols: Dict[str, pd.Series] = {}
+        uid = der.unique_tech_id
+        zero = lambda: pd.Series(0.0, index=years, dtype=float)
+
+        capex = der.get_capex()
+        cap = zero()
+        cap[CAPEX_ROW] = -capex
+        cols[f"{uid} Capital Cost"] = cap
+
+        df = der.proforma_report(opt_years)
+        if df is not None:
+            for name in df.columns:
+                col = zero()
+                for per, val in df[name].items():
+                    yr = per.year if hasattr(per, "year") else int(per)
+                    if yr in col.index:
+                        col[yr] = val
+                cols[name] = col
+
+        # lifecycle: decommissioning + salvage at end of analysis
+        # (reference CBA.py:409-438 + DERExtension semantics)
+        decomm = float(der.keys.get("decommissioning_cost", 0) or 0)
+        dec = zero()
+        if decomm:
+            dec[self.end_year] = -decomm
+        cols[f"{uid} Decommissioning Cost"] = dec
+        salvage = self._salvage_value(der, capex)
+        sal = zero()
+        if salvage:
+            sal[self.end_year] = salvage
+        cols[f"{uid} Salvage Value"] = sal
+        return cols
+
+    def _salvage_value(self, der, capex: float) -> float:
+        """'sunk cost' -> 0; 'linear salvage value' -> capex * remaining
+        fraction of expected lifetime at end of analysis; numeric -> $."""
+        raw = der.keys.get("salvage_value", 0)
+        if isinstance(raw, str):
+            label = raw.strip().lower()
+            if label == "sunk cost":
+                return 0.0
+            if label == "linear salvage value":
+                lifetime = int(der.keys.get("expected_lifetime", 0) or 0)
+                op_year = int(der.keys.get("operation_year", self.start_year)
+                              or self.start_year)
+                if not lifetime:
+                    return 0.0
+                used = self.end_year - op_year + 1
+                frac = max(0.0, (lifetime - used) / lifetime)
+                return capex * frac
+            try:
+                return float(raw)
+            except ValueError:
+                return 0.0
+        return float(raw or 0)
+
+    def _fill_forward(self, proforma: pd.DataFrame,
+                      opt_years: List[int]) -> pd.DataFrame:
+        """Copy each non-optimized year's value from the nearest previous
+        optimized year (escalation hooks per-stream later)."""
+        years = [y for y in proforma.index if y != CAPEX_ROW]
+        opt_set = sorted(set(opt_years))
+        for y in years:
+            if y in opt_set:
+                continue
+            prev = [o for o in opt_set if o < y]
+            src = prev[-1] if prev else opt_set[0]
+            for colname in proforma.columns:
+                col = proforma[colname]
+                # only fill operating rows (CAPEX/salvage/decommissioning
+                # rows live on specific years)
+                if "Capital Cost" in colname:
+                    continue
+                if "Salvage" in colname or "Decommissioning" in colname:
+                    continue
+                if col[y] == 0.0 and col[src] != 0.0:
+                    proforma.loc[y, colname] = col[src]
+        return proforma
+
+    # ------------------------------------------------------------------
+    def calculate_taxes(self, proforma: pd.DataFrame, ders
+                        ) -> Optional[pd.Series]:
+        """MACRS depreciation + state/federal income tax on yearly net
+        income (reference CBA.py:440-477)."""
+        overall_rate = (self.federal_tax_rate
+                        + self.state_tax_rate * (1 - self.federal_tax_rate))
+        if overall_rate == 0:
+            return None
+        years = [y for y in proforma.index if y != CAPEX_ROW]
+        depreciation = pd.Series(0.0, index=years)
+        for der in ders:
+            term = der.keys.get("macrs_term")
+            capex = der.get_capex()
+            if not term or not capex:
+                continue
+            table = MACRS_TABLES.get(int(float(term)))
+            if table is None:
+                TellUser.warning(f"no MACRS table for term {term}; skipped")
+                continue
+            op_year = int(der.keys.get("operation_year", self.start_year)
+                          or self.start_year)
+            for k, pct in enumerate(table):
+                yr = op_year + k
+                if yr in depreciation.index:
+                    depreciation[yr] += -capex * pct / 100.0
+        taxes = pd.Series(0.0, index=[CAPEX_ROW] + years)
+        yearly_net = proforma.loc[years].sum(axis=1)
+        taxable = yearly_net + depreciation
+        burden = -taxable.clip(lower=0.0) * overall_rate
+        taxes.loc[years] = burden
+        self.tax_breakdown = pd.DataFrame({
+            "Depreciation": depreciation, "Taxable Income": taxable,
+            "Tax Burden": burden})
+        return taxes
+
+    # ------------------------------------------------------------------
+    def npv_report(self, proforma: pd.DataFrame) -> pd.DataFrame:
+        rate = self.npv_discount_rate
+        out = {}
+        for colname in proforma.columns:
+            if colname == "Yearly Net Value":
+                continue
+            vals = proforma[colname].to_numpy(dtype=float)
+            out[colname] = npv_series(rate, vals)
+        total = sum(out.values())
+        out["Lifetime Present Value"] = total
+        return pd.DataFrame(out, index=["NPV"])
+
+    def payback_report(self, proforma: pd.DataFrame) -> pd.DataFrame:
+        """Simple payback = capex / first-year net benefit; discounted
+        payback from cumulative discounted net (reference CBA.py:479-523)."""
+        capex = -float(proforma.loc[CAPEX_ROW].drop(
+            labels=["Yearly Net Value"], errors="ignore").sum())
+        years = [y for y in proforma.index if y != CAPEX_ROW]
+        net = proforma.loc[years, "Yearly Net Value"].to_numpy(dtype=float)
+        first = net[0] if len(net) else 0.0
+        payback = capex / first if first > 0 else float("nan")
+        rate = self.npv_discount_rate
+        disc = np.array([v / (1 + rate) ** (k + 1) for k, v in enumerate(net)])
+        cum = np.cumsum(disc)
+        dpb = float("nan")
+        for k, c in enumerate(cum):
+            if c >= capex:
+                over = c - capex
+                dpb = (k + 1) - over / disc[k] if disc[k] else (k + 1)
+                break
+        cashflow = np.concatenate([[-capex], net])
+        rate_irr = irr(cashflow)
+        npv_total = npv_series(rate, np.concatenate([[-capex], net]))
+        benefits = np.where(net > 0, net, 0.0)
+        costs = np.where(net < 0, -net, 0.0)
+        pv_ben = npv_series(rate, np.concatenate([[0.0], benefits]))
+        pv_cost = capex + npv_series(rate, np.concatenate([[0.0], costs]))
+        bcr = pv_cost / pv_ben if pv_ben else float("nan")
+        return pd.DataFrame({
+            "Unit": ["Years", "$", "-"],
+            "Payback Period": [payback, None, None],
+            "Discounted Payback Period": [dpb, None, None],
+            "Lifetime Net Present Value": [None, npv_total, None],
+            "Internal Rate of Return": [None, None, rate_irr],
+            "Cost-Benefit Ratio": [None, None, bcr],
+        })
+
+    def cost_benefit_report(self, proforma: pd.DataFrame) -> pd.DataFrame:
+        rate = self.npv_discount_rate
+        rows = {}
+        tot_cost = tot_ben = 0.0
+        for colname in proforma.columns:
+            if colname == "Yearly Net Value":
+                continue
+            pv = npv_series(rate, proforma[colname].to_numpy(dtype=float))
+            cost, ben = (-pv, 0.0) if pv < 0 else (0.0, pv)
+            rows[colname] = {"Cost ($)": cost, "Benefit ($)": ben}
+            tot_cost += cost
+            tot_ben += ben
+        out = pd.DataFrame(rows).T
+        top = pd.DataFrame(
+            {"Cost ($)": [tot_cost], "Benefit ($)": [tot_ben]},
+            index=["Lifetime Present Value"])
+        return pd.concat([top, out])

@@ -1,0 +1,1 @@
+"""DER technologies and value streams."""

@@ -1,0 +1,3 @@
+"""DER technology components."""
+from .base import DER
+from .ess import Battery, EnergyStorage

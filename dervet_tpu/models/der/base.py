@@ -11,12 +11,13 @@ batched solution tensor.
 """
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 import pandas as pd
 
-from ...ops.lp import LPBuilder
+from ...ops.lp import LPBuilder, VarRef
+from ...scenario.window import WindowContext
 
 
 class DER:
@@ -26,10 +27,11 @@ class DER:
 
     def __init__(self, tag: str, der_id: str, keys: Dict, scenario: Dict):
         self.tag = tag
-        self.id = der_id
+        self.id = der_id or ""
         self.name = str(keys.get("name", tag))
         self.dt = float(scenario.get("dt", 1))
         self.keys = keys
+        self.scenario = scenario
         # full-year dispatch results, filled by the scenario loop
         self.variables_df: Optional[pd.DataFrame] = None
 
@@ -38,28 +40,47 @@ class DER:
     def unique_tech_id(self) -> str:
         return f"{self.tag.upper()}: {self.name}"
 
+    def col(self, quantity: str) -> str:
+        """Reference output column name, e.g. 'BATTERY: es Discharge (kW)'."""
+        return f"{self.unique_tech_id} {quantity}"
+
     # ---------- LP assembly --------------------------------------------
     def vname(self, var: str) -> str:
         return f"{self.tag}-{self.id or '1'}/{var}"
 
-    def build(self, b: LPBuilder, T: int, data: Dict) -> None:
-        """Register variables/constraints/costs for a T-step window.
+    def build(self, b: LPBuilder, ctx: WindowContext) -> None:
+        """Register variables/constraints/costs for one window.
 
-        ``data`` carries per-window arrays (prices, profiles) and scalars
-        (annuity_scalar).  Implementations must create identical structure
-        for equal T so windows can share one compiled solver.
+        Implementations must create identical *structure* for equal window
+        length T (data may differ) so same-length windows share one
+        compiled solver and batch onto the TPU together.
         """
         raise NotImplementedError
 
-    # power contributions to the POI balance, as (varname, sign) pairs
-    def generation_vars(self):
+    # ---------- POI interface ------------------------------------------
+    def power_terms(self, b: LPBuilder) -> List[Tuple[VarRef, float]]:
+        """Decision-variable contributions to net power at the POI.
+
+        Returns ``(ref, sign)`` pairs; sign +1 injects power to the grid
+        (discharge/generation), -1 consumes (charge/load).
+        """
         return []
 
-    def load_vars(self):
-        return []
+    def fixed_load(self, ctx: WindowContext) -> Optional[np.ndarray]:
+        """Constant (non-decision) load profile in kW, or None."""
+        return None
 
-    # state of energy contribution (varname) or None
-    def soe_var(self) -> Optional[str]:
+    def soe_term(self, b: LPBuilder) -> Optional[VarRef]:
+        """State-of-energy block for aggregate energy requirements."""
+        return None
+
+    # full-horizon report series for the POI totals (post-solve)
+    def load_series(self) -> Optional[np.ndarray]:
+        """Effective load (kW) this DER contributes, incl. fixed loads."""
+        return None
+
+    def generation_series(self) -> Optional[np.ndarray]:
+        """Generation (kW) this DER contributes (storage reports separately)."""
         return None
 
     # ---------- results -------------------------------------------------
@@ -68,12 +89,17 @@ class DER:
         self.variables_df = pd.DataFrame(values, index=index)
 
     def timeseries_report(self) -> pd.DataFrame:
-        return pd.DataFrame(index=self.variables_df.index)
+        idx = self.variables_df.index if self.variables_df is not None else None
+        return pd.DataFrame(index=idx)
 
     def monthly_report(self) -> pd.DataFrame:
         return pd.DataFrame()
 
-    def proforma_report(self, opt_years, results: pd.DataFrame) -> Optional[pd.DataFrame]:
+    def proforma_report(self, opt_years: List[int],
+                        apply_inflation_rate_func=None,
+                        fill_forward_func=None) -> Optional[pd.DataFrame]:
+        """Per-year cost/benefit rows keyed by pd.Period years (reference:
+        DER.proforma_report surface; CAPEX year handled by the CBA)."""
         return None
 
     def get_capex(self) -> float:
@@ -82,7 +108,10 @@ class DER:
     def sizing_summary(self) -> Dict:
         return {}
 
-    # operational window (DERExtension surface: operation_year gating)
+    # ---------- lifecycle (DERExtension surface) -----------------------
     def operational(self, year: int) -> bool:
         op_year = int(self.keys.get("operation_year", 0) or 0)
         return year >= op_year if op_year else True
+
+    def being_sized(self) -> bool:
+        return False

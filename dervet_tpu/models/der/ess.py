@@ -1,0 +1,230 @@
+"""Energy-storage physics as LP blocks (EnergyStorage base + Battery).
+
+Re-implements the behavior of the reference's storagevet
+``Technology.EnergyStorage`` + ``BatteryTech.Battery`` + dervet
+``MicrogridDER/ESSSizing.py`` + ``MicrogridDER/Battery.py`` (SURVEY.md
+§2.4/§2.8) as structured constraint rows instead of CVXPY expressions:
+
+* variables per window: ``ene`` (end-of-step state of energy, kWh),
+  ``ch`` (charging power, kW), ``dis`` (discharging power, kW)
+* SOE evolution with round-trip efficiency on charge and self-discharge,
+  window boundary condition pinning first/last SOE to the target
+  (windows start and end at ``soc_target`` — this is what makes windows
+  independent and therefore batchable on the scenario axis)
+* bounds from rated capacities and SOC limits
+* optional daily cycle-count limit as per-day energy rows
+
+Inputs are the reference's Battery tag keys (percent-valued keys are
+converted to fractions here).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+import scipy.sparse as sp
+
+from ...ops.lp import LPBuilder, VarRef
+from ...scenario.window import WindowContext
+from ...utils.errors import ParameterError, TellUser
+from .base import DER
+
+
+class EnergyStorage(DER):
+    """Generic electric energy-storage system (reference: storagevet
+    EnergyStorage surface, SURVEY.md §2.8)."""
+
+    technology_type = "Energy Storage System"
+
+    def __init__(self, tag: str, der_id: str, keys: Dict, scenario: Dict):
+        super().__init__(tag, der_id, keys, scenario)
+        g = lambda k, d=0.0: float(keys.get(k, d) or 0.0)
+        self.rte = g("rte", 100.0) / 100.0
+        self.sdr = g("sdr") / 100.0            # self-discharge, fraction/step
+        self.llsoc = g("llsoc") / 100.0
+        self.ulsoc = g("ulsoc", 100.0) / 100.0
+        self.soc_target = g("soc_target", 50.0) / 100.0
+        self.ch_max_rated = g("ch_max_rated")
+        self.dis_max_rated = g("dis_max_rated")
+        self.ch_min_rated = g("ch_min_rated")
+        self.dis_min_rated = g("dis_min_rated")
+        self.ene_max_rated = g("ene_max_rated")
+        self.duration_max = g("duration_max")
+        self.daily_cycle_limit = g("daily_cycle_limit")
+        self.hp = g("hp")                       # house power (kW, constant)
+        self.variable_om = g("OMexpenses") / 1000.0   # $/MWh -> $/kWh
+        self.fixed_om_per_kw = g("fixedOM")           # $/kW-yr on discharge
+        self.ccost = g("ccost")
+        self.ccost_kw = g("ccost_kw")
+        self.ccost_kwh = g("ccost_kwh")
+        self.incl_binary = bool(scenario.get("binary", False))
+        if (self.ch_min_rated or self.dis_min_rated) and not self.incl_binary:
+            TellUser.warning(f"{self.name}: nonzero ch/dis minimums require the "
+                             "binary formulation; ignored in the LP relaxation")
+        # fraction of rated energy usable (degradation hooks update this)
+        self.soh = 1.0
+
+    # ---------------- capacity accessors (sizing overrides later) ------
+    def energy_capacity(self) -> float:
+        return self.ene_max_rated
+
+    def charge_capacity(self) -> float:
+        return self.ch_max_rated
+
+    def discharge_capacity(self) -> float:
+        return self.dis_max_rated
+
+    def operational_max_energy(self) -> float:
+        return self.ulsoc * self.soh * self.energy_capacity()
+
+    def operational_min_energy(self) -> float:
+        return self.llsoc * self.soh * self.energy_capacity()
+
+    @property
+    def ene_target(self) -> float:
+        return self.soc_target * self.soh * self.energy_capacity()
+
+    # ---------------- LP assembly --------------------------------------
+    def build(self, b: LPBuilder, ctx: WindowContext) -> None:
+        T, dt = ctx.T, ctx.dt
+        e_max = self.operational_max_energy()
+        e_min = self.operational_min_energy()
+        e0 = ctx.carry.get(self.vname("soe0"), self.ene_target)
+
+        ene = b.var(self.vname("ene"), T, lb=e_min, ub=e_max)
+        ch = b.var(self.vname("ch"), T, lb=0.0, ub=self.charge_capacity())
+        dis = b.var(self.vname("dis"), T, lb=0.0, ub=self.discharge_capacity())
+
+        # SOE evolution: ene[t]*(1+sdr) - ene[t-1] - rte*dt*ch[t] + dt*dis[t] = 0
+        # with ene[-1] := e0 (window-entry SOE).  Sparse bidiagonal on ene.
+        diag = sp.diags([np.full(T, 1.0 + self.sdr), np.full(T - 1, -1.0)],
+                        offsets=[0, -1], format="csr")
+        rhs = np.zeros(T)
+        rhs[0] = e0
+        b.add_rows(self.vname("soe"), [
+            (ene, diag), (ch, -self.rte * dt), (dis, dt)], "eq", rhs)
+        # end-of-window SOE pinned back to target (reference keeps windows
+        # independent this way; storagevet EnergyStorage constraint surface)
+        end_row = np.zeros(T)
+        end_row[T - 1] = 1.0
+        b.add_rows(self.vname("soe_end"), [(ene, sp.csr_matrix(end_row))],
+                   "eq", np.array([self.ene_target]))
+
+        if self.daily_cycle_limit > 0:
+            self._daily_cycle_rows(b, ctx, dis)
+
+        # operating costs
+        if self.variable_om:
+            b.add_cost(dis, self.variable_om * dt * ctx.annuity_scalar)
+        if self.fixed_om_per_kw:
+            b.add_const_cost(self.fixed_om_per_kw * self.discharge_capacity()
+                             * ctx.annuity_scalar * (T * dt) / 8760.0)
+
+    def _daily_cycle_rows(self, b: LPBuilder, ctx: WindowContext, dis: VarRef):
+        """sum_day(dis)*dt <= daily_cycle_limit * usable energy, per day."""
+        days = ctx.index.normalize()
+        uniq = days.unique()
+        rows_i, cols_i = [], []
+        for i, d in enumerate(uniq):
+            idx = np.nonzero(np.asarray(days == d))[0]
+            rows_i.append(np.full(len(idx), i))
+            cols_i.append(idx)
+        mat = sp.coo_matrix(
+            (np.full(sum(len(c) for c in cols_i), ctx.dt),
+             (np.concatenate(rows_i), np.concatenate(cols_i))),
+            shape=(len(uniq), ctx.T)).tocsr()
+        cap = self.daily_cycle_limit * (self.operational_max_energy()
+                                        - self.operational_min_energy())
+        b.add_rows(self.vname("daily_cycle"), [(dis, mat)], "le",
+                   np.full(len(uniq), cap))
+
+    # ---------------- POI interface -------------------------------------
+    def power_terms(self, b: LPBuilder) -> List[Tuple[VarRef, float]]:
+        return [(b[self.vname("dis")], +1.0), (b[self.vname("ch")], -1.0)]
+
+    def fixed_load(self, ctx: WindowContext) -> Optional[np.ndarray]:
+        if self.hp:
+            return np.full(ctx.T, self.hp)
+        return None
+
+    def soe_term(self, b: LPBuilder) -> Optional[VarRef]:
+        return b[self.vname("ene")]
+
+    def load_series(self):
+        if self.hp and self.variables_df is not None:
+            return np.full(len(self.variables_df), self.hp)
+        return None
+
+    # ---------------- results -------------------------------------------
+    def store_dispatch(self, index, values):
+        super().store_dispatch(index, values)
+        # SOE hand-off: next run starts from final energy (within a run the
+        # windows pin to ene_target; carry is for degradation-coupled reruns)
+
+    def timeseries_report(self) -> pd.DataFrame:
+        v = self.variables_df
+        out = pd.DataFrame(index=v.index)
+        e_max = self.operational_max_energy()
+        out[self.col("Charge (kW)")] = v["ch"]
+        out[self.col("Discharge (kW)")] = v["dis"]
+        out[self.col("Power (kW)")] = v["dis"] - v["ch"]
+        out[self.col("State of Energy (kWh)")] = v["ene"]
+        out[self.col("SOC (%)")] = v["ene"] / (e_max if e_max else 1.0)
+        return out
+
+    def get_capex(self) -> float:
+        return (self.ccost + self.ccost_kw * self.discharge_capacity()
+                + self.ccost_kwh * self.energy_capacity())
+
+    def proforma_report(self, opt_years, apply_inflation_rate_func=None,
+                        fill_forward_func=None):
+        """Fixed + variable O&M rows per optimized year (reference:
+        storagevet EnergyStorage proforma surface, SURVEY.md §2.8)."""
+        uid = self.unique_tech_id
+        rows = {}
+        v = self.variables_df
+        for yr in opt_years:
+            per = pd.Period(yr, freq="Y")
+            fixed = -self.fixed_om_per_kw * self.discharge_capacity()
+            var = 0.0
+            if v is not None and "dis" in v:
+                mask = v.index.year == yr
+                var = -self.variable_om * self.dt * float(v.loc[mask, "dis"].sum())
+            rows[per] = {f"{uid} Fixed O&M Cost": fixed,
+                         f"{uid} Variable O&M Cost": var}
+        return pd.DataFrame(rows).T
+
+    def sizing_summary(self) -> Dict:
+        dis = self.discharge_capacity()
+        return {
+            "DER": self.name,
+            "Energy Rating (kWh)": self.energy_capacity(),
+            "Charge Rating (kW)": self.charge_capacity(),
+            "Discharge Rating (kW)": dis,
+            "Round Trip Efficiency (%)": self.rte,
+            "Lower Limit on SOC (%)": self.llsoc,
+            "Upper Limit on SOC (%)": self.ulsoc,
+            "Duration (hours)": (self.energy_capacity() / dis) if dis else 0,
+            "Capital Cost ($)": self.ccost,
+            "Capital Cost ($/kW)": self.ccost_kw,
+            "Capital Cost ($/kWh)": self.ccost_kwh,
+        }
+
+
+class Battery(EnergyStorage):
+    """Battery ESS (reference: dervet/MicrogridDER/Battery.py:66-110 adds a
+    duration_max sizing constraint + cycle-degradation module on top of the
+    storagevet battery)."""
+
+    def __init__(self, keys: Dict, scenario: Dict, der_id: str = "",
+                 cycle_life: Optional[pd.DataFrame] = None):
+        super().__init__("Battery", der_id, keys, scenario)
+        self.incl_cycle_degrade = bool(keys.get("incl_cycle_degrade", False))
+        self.cycle_life = cycle_life
+        if self.duration_max and self.dis_max_rated:
+            if self.ene_max_rated > self.duration_max * self.dis_max_rated:
+                raise ParameterError(
+                    f"{self.name}: energy rating {self.ene_max_rated} exceeds "
+                    f"duration_max*discharge rating "
+                    f"{self.duration_max * self.dis_max_rated}")

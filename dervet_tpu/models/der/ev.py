@@ -1,0 +1,144 @@
+"""Electric-vehicle DERs.
+
+Re-implements dervet/MicrogridDER/ElectricVehicles.py (SURVEY.md §2.4):
+
+* ``ElectricVehicle1`` — single-EV charging: hour-of-day plug window,
+  charge only while plugged, daily charge energy must reach ``ene_target``
+  by plug-out (reference :194-297 forces SOE=0 at plug-in and SOE=target
+  at plug-out; cumulative-charge rows express the same reachable set
+  without an SOE variable).
+* ``ElectricVehicle2`` — fleet baseline-load control: charging bounded
+  between ``(1-max_load_ctrl)*baseline`` and ``baseline`` with lost-load
+  cost on the shed energy (reference :495-544).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+import scipy.sparse as sp
+
+from ...ops.lp import LPBuilder, VarRef
+from ...scenario.window import WindowContext
+from ...utils.errors import TimeseriesDataError
+from .base import DER
+
+
+class ElectricVehicle1(DER):
+    """Single-EV controlled charging."""
+
+    technology_type = "Electric Vehicle"
+
+    def __init__(self, keys: Dict, scenario: Dict, der_id: str = "",
+                 datasets=None):
+        super().__init__("ElectricVehicle1", der_id, keys, scenario)
+        g = lambda k, d=0.0: float(keys.get(k, d) or 0.0)
+        self.ch_max_rated = g("ch_max_rated")
+        self.ch_min_rated = g("ch_min_rated")
+        self.ene_target = g("ene_target")
+        self.plugin_time = int(g("plugin_time"))
+        self.plugout_time = int(g("plugout_time"))
+
+    def _plugged_mask(self, index: pd.DatetimeIndex) -> np.ndarray:
+        hours = index.hour.to_numpy()
+        if self.plugin_time <= self.plugout_time:
+            return (hours >= self.plugin_time) & (hours < self.plugout_time)
+        return (hours >= self.plugin_time) | (hours < self.plugout_time)
+
+    def build(self, b: LPBuilder, ctx: WindowContext) -> None:
+        T, dt = ctx.T, ctx.dt
+        plugged = self._plugged_mask(ctx.index)
+        ub = np.where(plugged, self.ch_max_rated, 0.0)
+        ch = b.var(self.vname("ch"), T, lb=0.0, ub=ub)
+        # one charge-session row per plug-out boundary: energy delivered in
+        # each plugged session == ene_target
+        session = np.zeros(T, dtype=np.int64)
+        sid = 0
+        prev = False
+        for t, p in enumerate(plugged):
+            if p and not prev:
+                sid += 1
+            session[t] = sid if p else 0
+            prev = p
+        n_sessions = sid
+        complete = []
+        for s in range(1, n_sessions + 1):
+            idx = np.nonzero(session == s)[0]
+            # only enforce the target for sessions fully inside the window:
+            # a session truncated by either window boundary (started before
+            # the window or still plugged at its end) must not carry the
+            # full-energy equality — it would over-constrain or go infeasible
+            starts_at_boundary = idx[0] == 0 and plugged[0]
+            ends_at_boundary = idx[-1] == T - 1 and plugged[-1]
+            if not starts_at_boundary and not ends_at_boundary:
+                complete.append(idx)
+        if complete:
+            rows_i = np.concatenate([np.full(len(ix), i)
+                                     for i, ix in enumerate(complete)])
+            cols_i = np.concatenate(complete)
+            mat = sp.coo_matrix((np.full(len(cols_i), dt), (rows_i, cols_i)),
+                                shape=(len(complete), T)).tocsr()
+            b.add_rows(self.vname("session_energy"), [(ch, mat)], "eq",
+                       np.full(len(complete), self.ene_target))
+
+    def power_terms(self, b: LPBuilder) -> List[Tuple[VarRef, float]]:
+        return [(b[self.vname("ch")], -1.0)]
+
+    def load_series(self):
+        v = self.variables_df
+        return v["ch"].to_numpy() if v is not None and "ch" in v else None
+
+    def timeseries_report(self) -> pd.DataFrame:
+        v = self.variables_df
+        out = pd.DataFrame(index=v.index)
+        out[self.col("Charge (kW)")] = v["ch"]
+        return out
+
+
+class ElectricVehicle2(DER):
+    """Fleet-EV baseline-load control."""
+
+    technology_type = "Electric Vehicle"
+    BASELINE_COL = "EV fleet"
+
+    def __init__(self, keys: Dict, scenario: Dict, der_id: str = "",
+                 datasets=None):
+        super().__init__("ElectricVehicle2", der_id, keys, scenario)
+        g = lambda k, d=0.0: float(keys.get(k, d) or 0.0)
+        self.max_load_ctrl = g("max_load_ctrl") / 100.0
+        self.lost_load_cost = g("lost_load_cost")
+        self.datasets = datasets
+        if datasets is None or datasets.time_series is None:
+            raise TimeseriesDataError("ElectricVehicle2 requires a time series "
+                                      "with an 'EV fleet' baseline column")
+
+    def baseline(self, ctx: WindowContext) -> np.ndarray:
+        arr = ctx.col(self.BASELINE_COL, self.id or "1")
+        if arr is None:
+            raise TimeseriesDataError("missing 'EV fleet' baseline column")
+        return arr
+
+    def build(self, b: LPBuilder, ctx: WindowContext) -> None:
+        base = self.baseline(ctx)
+        lb = (1.0 - self.max_load_ctrl) * base
+        ch = b.var(self.vname("ch"), ctx.T, lb=lb, ub=base)
+        # lost-load cost on shed baseline energy: cost*(base-ch)*dt; the
+        # constant part goes to c0 for faithful objective reporting
+        if self.lost_load_cost:
+            b.add_cost(ch, -self.lost_load_cost * ctx.dt * ctx.annuity_scalar)
+            b.add_const_cost(float(np.sum(base)) * self.lost_load_cost
+                             * ctx.dt * ctx.annuity_scalar)
+
+    def power_terms(self, b: LPBuilder) -> List[Tuple[VarRef, float]]:
+        return [(b[self.vname("ch")], -1.0)]
+
+    def load_series(self):
+        v = self.variables_df
+        return v["ch"].to_numpy() if v is not None and "ch" in v else None
+
+    def timeseries_report(self) -> pd.DataFrame:
+        v = self.variables_df
+        out = pd.DataFrame(index=v.index)
+        out[self.col("Charge (kW)")] = v["ch"]
+        return out

@@ -1,0 +1,221 @@
+"""Dispatchable rotating generators: ICE, DieselGenset, CT, CHP.
+
+Re-implements the behavior of dervet/MicrogridDER/
+RotatingGeneratorSizing.py + ICE.py + DieselGenset.py +
+CombustionTurbine.py + CombinedHeatPower.py (SURVEY.md §2.4) on the
+storagevet RotatingGenerator surface: electric output ``elec`` per
+timestep bounded by ``n * rated_capacity``; fuel + variable O&M costs in
+the objective.  The binary on/off + min-power formulation is relaxed in
+the LP (min_power requires MILP; the reference itself forbids
+binary+sizing, MicrogridPOI.py:132-147).
+
+CHP adds recovered-heat variables (steam / hot water) tied to electric
+output; the POI consumes them in the thermal balance.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ...ops.lp import LPBuilder, VarRef
+from ...scenario.window import WindowContext
+from ...utils.errors import TellUser
+from .base import DER
+
+GAS_PRICE_COL = "Natural Gas Price ($/MillionBTU)"
+
+
+class RotatingGenerator(DER):
+    """Base dispatchable generator (storagevet RotatingGenerator surface)."""
+
+    technology_type = "Generator"
+
+    def __init__(self, tag: str, keys: Dict, scenario: Dict, der_id: str = ""):
+        super().__init__(tag, der_id, keys, scenario)
+        g = lambda k, d=0.0: float(keys.get(k, d) or 0.0)
+        self.rated_power = g("rated_capacity")
+        self.n_units = max(int(keys.get("n", 1) or 1), 1)
+        self.min_power = g("min_power")
+        self.variable_om = g("variable_om_cost")      # $/kWh
+        self.fixed_om_per_kw = g("fixed_om_cost")     # $/kW-yr
+        self.ccost = g("ccost")
+        self.ccost_kw = g("ccost_kW")
+        if self.min_power and not scenario.get("binary"):
+            TellUser.warning(f"{self.name}: min_power needs the binary "
+                             "formulation; relaxed to 0 in the LP")
+
+    @property
+    def max_power_out(self) -> float:
+        return self.n_units * self.rated_power
+
+    # fuel $/kWh for one window (constant or monthly-priced)
+    def fuel_cost_per_kwh(self, ctx: WindowContext) -> float:
+        return 0.0
+
+    def build(self, b: LPBuilder, ctx: WindowContext) -> None:
+        elec = b.var(self.vname("elec"), ctx.T, lb=0.0, ub=self.max_power_out)
+        cost = (self.variable_om + self.fuel_cost_per_kwh(ctx)) * ctx.dt
+        if cost:
+            b.add_cost(elec, cost * ctx.annuity_scalar)
+        if self.fixed_om_per_kw:
+            b.add_const_cost(self.fixed_om_per_kw * self.max_power_out
+                             * ctx.annuity_scalar * (ctx.T * ctx.dt) / 8760.0)
+
+    def power_terms(self, b: LPBuilder) -> List[Tuple[VarRef, float]]:
+        return [(b[self.vname("elec")], +1.0)]
+
+    def generation_series(self):
+        v = self.variables_df
+        return v["elec"].to_numpy() if v is not None and "elec" in v else None
+
+    def timeseries_report(self) -> pd.DataFrame:
+        v = self.variables_df
+        out = pd.DataFrame(index=v.index)
+        out[self.col("Electric Generation (kW)")] = v["elec"]
+        return out
+
+    def get_capex(self) -> float:
+        return self.ccost + self.ccost_kw * self.max_power_out
+
+    def proforma_report(self, opt_years, apply_inflation_rate_func=None,
+                        fill_forward_func=None):
+        """Fixed O&M + variable O&M + fuel cost rows (reference:
+        CombustionTurbine.py:122-152 fuel rows; storagevet generator O&M)."""
+        uid = self.unique_tech_id
+        rows = {}
+        v = self.variables_df
+        for yr in opt_years:
+            per = pd.Period(yr, freq="Y")
+            row = {f"{uid} Fixed O&M Cost":
+                   -self.fixed_om_per_kw * self.max_power_out}
+            gen_kwh = 0.0
+            if v is not None and "elec" in v:
+                mask = v.index.year == yr
+                gen_kwh = self.dt * float(v.loc[mask, "elec"].sum())
+            row[f"{uid} Variable O&M Cost"] = -self.variable_om * gen_kwh
+            fuel = self._yearly_fuel_cost(yr, gen_kwh)
+            if fuel is not None:
+                row[f"{uid} Fuel Cost"] = fuel
+            rows[per] = row
+        return pd.DataFrame(rows).T
+
+    def _yearly_fuel_cost(self, year: int, gen_kwh: float):
+        return None
+
+    def sizing_summary(self) -> Dict:
+        return {
+            "DER": self.name,
+            "Power Capacity (kW)": self.max_power_out,
+            "Capital Cost ($)": self.ccost,
+            "Capital Cost ($/kW)": self.ccost_kw,
+            "Quantity": self.n_units,
+        }
+
+
+class ICE(RotatingGenerator):
+    """Internal-combustion engine: liquid fuel priced per gallon
+    (reference: MicrogridDER/ICE.py:84-95; efficiency in gal/kWh)."""
+
+    def __init__(self, keys: Dict, scenario: Dict, der_id: str = "",
+                 datasets=None):
+        super().__init__(keys.get("__tag__", "ICE"), keys, scenario, der_id)
+        self.efficiency = float(keys.get("efficiency", 0) or 0)   # gal/kWh
+        self.fuel_cost = float(keys.get("fuel_cost", 0) or 0)     # $/gal
+
+    def fuel_cost_per_kwh(self, ctx: WindowContext) -> float:
+        return self.efficiency * self.fuel_cost
+
+    def _yearly_fuel_cost(self, year: int, gen_kwh: float):
+        return -self.efficiency * self.fuel_cost * gen_kwh
+
+
+class DieselGenset(ICE):
+    """ICE barred from market participation (reference:
+    MicrogridDER/DieselGenset.py:54-92 zeroes its up/down schedules)."""
+
+    def __init__(self, keys: Dict, scenario: Dict, der_id: str = "",
+                 datasets=None):
+        keys = dict(keys)
+        keys["__tag__"] = "DieselGenset"
+        super().__init__(keys, scenario, der_id, datasets)
+
+    market_participation = False
+
+
+class CT(RotatingGenerator):
+    """Combustion turbine: natural-gas fuel via heat rate x monthly gas
+    price (reference: MicrogridDER/CombustionTurbine.py:79-88)."""
+
+    def __init__(self, keys: Dict, scenario: Dict, der_id: str = "",
+                 datasets=None, tag: str = "CT"):
+        super().__init__(tag, keys, scenario, der_id)
+        self.heat_rate = float(keys.get("heat_rate", 0) or 0)  # BTU/kWh
+        self.datasets = datasets
+
+    def fuel_cost_per_kwh(self, ctx: WindowContext) -> float:
+        price = ctx.monthly_value(GAS_PRICE_COL, default=0.0) or 0.0
+        return self.heat_rate / 1e6 * price   # BTU/kWh * $/MMBTU
+
+    def _yearly_fuel_cost(self, year: int, gen_kwh: float):
+        v = self.variables_df
+        monthly = getattr(self.datasets, "monthly", None) if self.datasets else None
+        if v is None or "elec" not in v or monthly is None:
+            return None
+        total = 0.0
+        mask_year = v.index.year == year
+        for month in range(1, 13):
+            mask = mask_year & (v.index.month == month)
+            if not mask.any():
+                continue
+            kwh = self.dt * float(v.loc[mask, "elec"].sum())
+            try:
+                price = float(monthly.loc[(year, month), GAS_PRICE_COL])
+            except KeyError:
+                price = 0.0
+            total += self.heat_rate / 1e6 * price * kwh
+        return -total
+
+
+class CHP(CT):
+    """Combined heat & power: recovered steam / hot-water tied to electric
+    output (reference: MicrogridDER/CombinedHeatPower.py:77-107 —
+    nonneg steam & hotwater, steam <= max_steam_ratio*hotwater,
+    (steam+hotwater)*electric_heat_ratio == elec)."""
+
+    def __init__(self, keys: Dict, scenario: Dict, der_id: str = "",
+                 datasets=None):
+        super().__init__(keys, scenario, der_id, datasets, tag="CHP")
+        self.electric_heat_ratio = float(keys.get("electric_heat_ratio", 0) or 0)
+        self.max_steam_ratio = float(keys.get("max_steam_ratio", 0) or 0)
+
+    def build(self, b: LPBuilder, ctx: WindowContext) -> None:
+        super().build(b, ctx)
+        elec = b[self.vname("elec")]
+        steam = b.var(self.vname("steam"), ctx.T, lb=0.0)
+        hotwater = b.var(self.vname("hotwater"), ctx.T, lb=0.0)
+        if self.max_steam_ratio:
+            b.add_rows(self.vname("steam_ratio"),
+                       [(steam, 1.0), (hotwater, -self.max_steam_ratio)],
+                       "le", 0.0)
+        if self.electric_heat_ratio:
+            b.add_rows(self.vname("heat_recovery"),
+                       [(steam, self.electric_heat_ratio),
+                        (hotwater, self.electric_heat_ratio),
+                        (elec, -1.0)], "eq", 0.0)
+
+    # recovered heat for the POI thermal balance (BTU/hr scale handled there)
+    def steam_term(self, b: LPBuilder) -> VarRef:
+        return b[self.vname("steam")]
+
+    def hotwater_term(self, b: LPBuilder) -> VarRef:
+        return b[self.vname("hotwater")]
+
+    def timeseries_report(self) -> pd.DataFrame:
+        out = super().timeseries_report()
+        v = self.variables_df
+        if "steam" in v:
+            out[self.col("Steam Heat Recovered (BTU/hr)")] = v["steam"]
+            out[self.col("Hot Water Heat Recovered (BTU/hr)")] = v["hotwater"]
+        return out

@@ -1,0 +1,89 @@
+"""PV / intermittent renewable resource.
+
+Re-implements dervet/MicrogridDER/IntermittentResourceSizing.py:70-91 +
+the storagevet PVSystem surface (SURVEY.md §2.4/§2.8): generation is a
+per-rated-kW profile times rated capacity; with ``curtail`` the dispatched
+output is a variable bounded above by that profile, otherwise it is a
+fixed injection.  Reliability credit factors ``nu``/``gamma`` and PPA
+pricing ride along for the reliability/financial layers.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ...ops.lp import LPBuilder, VarRef
+from ...scenario.window import WindowContext
+from ...utils.errors import TimeseriesDataError
+from .base import DER
+
+GEN_COL = "PV Gen (kW/rated kW)"
+
+
+class PV(DER):
+
+    technology_type = "Intermittent Resource"
+
+    def __init__(self, keys: Dict, scenario: Dict, der_id: str = "",
+                 datasets=None):
+        super().__init__("PV", der_id, keys, scenario)
+        g = lambda k, d=0.0: float(keys.get(k, d) or 0.0)
+        self.rated_capacity = g("rated_capacity")
+        self.curtail = bool(keys.get("curtail", False))
+        self.grid_charge = bool(keys.get("grid_charge", True))
+        self.inv_max = g("inv_max", 1e9)
+        self.nu = g("nu") / 100.0          # % of PV credited in power balance
+        self.gamma = g("gamma") / 100.0    # % of PV credited in energy
+        self.cost_per_kw = g("ccost_kW")
+        self.fixed_om_per_kw = g("fixed_om_cost")
+        self.ppa = bool(keys.get("PPA", False))
+        self.ppa_cost = g("PPA_cost")      # $/kWh production payment
+        self.growth = g("growth") / 100.0
+        if datasets is None or datasets.time_series is None:
+            raise TimeseriesDataError("PV requires a time series with "
+                                      f"{GEN_COL!r}")
+        from ...scenario.window import grab_column
+        if grab_column(datasets.time_series, GEN_COL, self.id) is None:
+            raise TimeseriesDataError(f"PV: missing column {GEN_COL!r}")
+
+    def max_generation(self, ctx: WindowContext) -> np.ndarray:
+        profile = ctx.col(GEN_COL, self.id)
+        return profile * self.rated_capacity
+
+    def build(self, b: LPBuilder, ctx: WindowContext) -> None:
+        gen_max = np.minimum(self.max_generation(ctx), self.inv_max)
+        if self.curtail:
+            b.var(self.vname("gen"), ctx.T, lb=0.0, ub=gen_max)
+        else:
+            b.var(self.vname("gen"), ctx.T, lb=gen_max, ub=gen_max)
+        if self.ppa and self.ppa_cost:
+            b.add_cost(b[self.vname("gen")],
+                       self.ppa_cost * ctx.dt * ctx.annuity_scalar)
+        if self.fixed_om_per_kw:
+            b.add_const_cost(self.fixed_om_per_kw * self.rated_capacity
+                             * ctx.annuity_scalar * (ctx.T * ctx.dt) / 8760.0)
+
+    def power_terms(self, b: LPBuilder) -> List[Tuple[VarRef, float]]:
+        return [(b[self.vname("gen")], +1.0)]
+
+    def generation_series(self):
+        v = self.variables_df
+        return v["gen"].to_numpy() if v is not None and "gen" in v else None
+
+    def timeseries_report(self) -> pd.DataFrame:
+        v = self.variables_df
+        out = pd.DataFrame(index=v.index)
+        out[self.col("Electric Generation (kW)")] = v["gen"]
+        return out
+
+    def get_capex(self) -> float:
+        return self.cost_per_kw * self.rated_capacity
+
+    def sizing_summary(self) -> Dict:
+        return {
+            "DER": self.name,
+            "Power Capacity (kW)": self.rated_capacity,
+            "Capital Cost ($/kW)": self.cost_per_kw,
+        }

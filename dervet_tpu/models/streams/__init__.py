@@ -1,0 +1,36 @@
+"""Value-stream registry (mirrors VS_CLASS_MAP, MicrogridScenario.py:83-98)."""
+from __future__ import annotations
+
+
+def registry():
+    from .da import DAEnergyTimeShift
+    reg = {
+        "DA": DAEnergyTimeShift,
+    }
+    try:
+        from .retail import EnergyTimeShift, DemandChargeReduction
+        reg["retailTimeShift"] = EnergyTimeShift
+        reg["DCM"] = DemandChargeReduction
+    except ImportError:
+        pass
+    try:
+        from .markets import (FrequencyRegulation, SpinningReserve,
+                              NonspinningReserve, LoadFollowing)
+        reg.update({"FR": FrequencyRegulation, "SR": SpinningReserve,
+                    "NSR": NonspinningReserve, "LF": LoadFollowing})
+    except ImportError:
+        pass
+    try:
+        from .programs import (Backup, Deferral, DemandResponse,
+                               ResourceAdequacy, UserConstraints, VoltVar)
+        reg.update({"Backup": Backup, "Deferral": Deferral,
+                    "DR": DemandResponse, "RA": ResourceAdequacy,
+                    "User": UserConstraints, "Volt": VoltVar})
+    except ImportError:
+        pass
+    try:
+        from .reliability import Reliability
+        reg["Reliability"] = Reliability
+    except ImportError:
+        pass
+    return reg

@@ -1,0 +1,2 @@
+"""Results registry and CSV reporting."""
+from .result import Result, CaseResult

@@ -1,0 +1,121 @@
+"""Results registry and per-case result collection.
+
+Re-designs dervet/MicrogridResult.py + the storagevet Result surface
+(SURVEY.md §2.7/§2.8): classmethod registry keyed by sensitivity case,
+per-case collection of timeseries/technology-summary/sizing frames, CSV
+output set with the reference's file names and column names (the golden
+tests compare by column name).  The financial frames (pro_forma, npv,
+payback, cost_benefit) are attached by the CBA layer.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, Optional
+
+import pandas as pd
+
+from ..utils.errors import TellUser
+
+
+class Result:
+    """Registry of per-case results for one DERVET run."""
+
+    @classmethod
+    def initialize(cls, cases) -> "Result":
+        first = cases[min(cases.keys())]
+        return cls(first.results, sensitivity_df=first.sensitivity_df)
+
+    def __init__(self, results_keys: Dict, sensitivity_df=None):
+        self.dir_abs_path = Path(results_keys.get("dir_absolute_path", "Results") or "Results")
+        self.csv_label = str(results_keys.get("label", "") or "")
+        if self.csv_label == "nan":
+            self.csv_label = ""
+        self.sensitivity_df = (sensitivity_df if sensitivity_df is not None
+                               else pd.DataFrame())
+        self.instances: Dict[int, CaseResult] = {}
+
+    def add_instance(self, key: int, scenario) -> "CaseResult":
+        inst = CaseResult(scenario, self.csv_label)
+        inst.collect_results()
+        inst.calculate_cba()
+        self.instances[key] = inst
+        return inst
+
+    def sensitivity_summary(self) -> Optional[pd.DataFrame]:
+        if self.sensitivity_df.empty:
+            return None
+        df = self.sensitivity_df.copy()
+        for key, inst in self.instances.items():
+            if inst.npv_df is not None and "Lifetime Present Value" in inst.npv_df:
+                df.loc[key, "Lifetime Net Present Value"] = \
+                    inst.npv_df["Lifetime Present Value"].iloc[0]
+        self.sensitivity_summary_df = df
+        return df
+
+    def save_as_csv(self, out_dir=None) -> None:
+        for key, inst in self.instances.items():
+            label = f"{self.csv_label}{key}" if len(self.instances) > 1 else self.csv_label
+            inst.save_as_csv(Path(out_dir or self.dir_abs_path), label)
+
+
+class CaseResult:
+    """Per-case result frames (reference: MicrogridResult instance)."""
+
+    def __init__(self, scenario, csv_label: str = ""):
+        self.scenario = scenario
+        self.csv_label = csv_label
+        self.time_series_data: Optional[pd.DataFrame] = None
+        self.technology_summary: Optional[pd.DataFrame] = None
+        self.sizing_df: Optional[pd.DataFrame] = None
+        self.monthly_data: Optional[pd.DataFrame] = None
+        self.objective_values: Optional[pd.DataFrame] = None
+        self.proforma_df: Optional[pd.DataFrame] = None
+        self.npv_df: Optional[pd.DataFrame] = None
+        self.payback_df: Optional[pd.DataFrame] = None
+        self.cost_benefit_df: Optional[pd.DataFrame] = None
+        self.drill_down_dict: Dict[str, pd.DataFrame] = {}
+
+    # ------------------------------------------------------------------
+    def collect_results(self) -> None:
+        s = self.scenario
+        self.time_series_data = s.timeseries_results()
+        self.technology_summary = pd.DataFrame(
+            [{"Type": d.technology_type, "Name": d.name} for d in s.ders])
+        self.sizing_df = s.poi.sizing_summary()
+        self.monthly_data = s.service_agg.monthly_report()
+        if s.objective_values:
+            self.objective_values = pd.DataFrame(s.objective_values).T
+
+    def calculate_cba(self) -> None:
+        from ..financial.cba import CostBenefitAnalysis
+        s = self.scenario
+        try:
+            cba = CostBenefitAnalysis(s.case.finance, s.start_year, s.end_year,
+                                      s.opt_years, dt=s.dt)
+        except Exception as e:  # financial inputs optional in early slices
+            TellUser.warning(f"CBA skipped: {e}")
+            return
+        cba.calculate(s.ders, s.streams, self.time_series_data, s.opt_years)
+        self.proforma_df = cba.proforma
+        self.npv_df = cba.npv
+        self.payback_df = cba.payback
+        self.cost_benefit_df = cba.cost_benefit
+
+    # ------------------------------------------------------------------
+    def save_as_csv(self, path: Path, label: str = "") -> None:
+        path.mkdir(parents=True, exist_ok=True)
+        def put(name, df, index=True):
+            if df is not None:
+                df.to_csv(path / f"{name}{label}.csv", index=index)
+        put("timeseries_results", self.time_series_data)
+        put("technology_summary", self.technology_summary, index=False)
+        put("size", self.sizing_df)
+        put("monthly_data", self.monthly_data)
+        put("objective_values", self.objective_values)
+        put("pro_forma", self.proforma_df)
+        put("npv", self.npv_df, index=False)
+        put("payback", self.payback_df, index=False)
+        put("cost_benefit", self.cost_benefit_df)
+        for name, df in self.drill_down_dict.items():
+            put(name, df)
+        TellUser.info(f"results saved to {path}")

@@ -1,0 +1,4 @@
+"""Scenario runtime: windows, POI, service aggregator, dispatch loop."""
+from .scenario import MicrogridScenario
+from .poi import POI
+from .aggregator import ServiceAggregator

@@ -1,0 +1,191 @@
+"""Point of interconnection: power balance, import/export limits, reports.
+
+Re-designs dervet/MicrogridPOI.py (reference :149-258 aggregates per-DER
+CVXPY expressions and posts interconnection constraints; :266-323 merges
+per-DER reports into Total columns).  Here the POI contributes constraint
+*rows over the union of DER variable blocks* — net power at the POI is a
+linear expression over every DER's power variables plus fixed loads, never
+a separate decision variable.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from ..models.der.base import DER
+from ..models.streams.base import SystemRequirement
+from ..ops.lp import LPBuilder
+from ..utils.errors import ParameterError, TellUser
+from .window import WindowContext
+
+
+class POI:
+    """Owns the DER list; assembles POI-level rows per window."""
+
+    def __init__(self, scenario_keys: Dict, der_list: List[DER]):
+        self.scenario = scenario_keys
+        self.der_list = der_list
+        self.active_ders: List[DER] = list(der_list)
+        self.apply_poi_constraints = bool(
+            scenario_keys.get("apply_interconnection_constraints", False))
+        self.max_export = float(scenario_keys.get("max_export", 0) or 0)
+        self.max_import = float(scenario_keys.get("max_import", 0) or 0)
+        self.incl_site_load = bool(scenario_keys.get("incl_site_load", False))
+        if self.apply_poi_constraints and self.max_import > 0:
+            raise ParameterError(
+                f"max_import must be <= 0 (import is negative net export), "
+                f"got {self.max_import}")
+        self.is_sizing_optimization = any(d.being_sized() for d in der_list)
+
+    # ------------------------------------------------------------------
+    def grab_active_ders(self, year: int) -> None:
+        self.active_ders = [d for d in self.der_list if d.operational(year)]
+
+    def _owns_site_load(self) -> bool:
+        """A ControllableLoad DER owns the 'Site Load (kW)' column; when one
+        is active the POI must not add the column again (reference: the Load
+        technology IS the site load, LoadControllable.py:253-260)."""
+        return any(d.technology_type == "Load" for d in self.active_ders)
+
+    def site_load(self, ctx: WindowContext) -> np.ndarray:
+        """Total constant load in the window: site load + DER fixed loads."""
+        load = np.zeros(ctx.T)
+        if self.incl_site_load and not self._owns_site_load():
+            site = ctx.col("Site Load (kW)")
+            if site is not None:
+                load += site
+        for der in self.active_ders:
+            fixed = der.fixed_load(ctx)
+            if fixed is not None:
+                load += fixed
+        return load
+
+    def net_export_terms(self, b: LPBuilder):
+        terms = []
+        for der in self.active_ders:
+            terms.extend(der.power_terms(b))
+        return terms
+
+    # ------------------------------------------------------------------
+    def build(self, b: LPBuilder, ctx: WindowContext,
+              requirements: List[SystemRequirement]) -> None:
+        terms = self.net_export_terms(b)
+        load = self.site_load(ctx)
+
+        if self.apply_poi_constraints and terms:
+            coef_terms = [(ref, np.full(ctx.T, sign)) for ref, sign in terms]
+            # net_export = sum(sign*var) - load;  max_import <= net <= max_export
+            b.add_rows("poi_export", coef_terms, "le", self.max_export + load)
+            b.add_rows("poi_import", coef_terms, "ge", self.max_import + load)
+
+        self._grid_charge_rows(b, ctx)
+        self._requirement_rows(b, ctx, requirements)
+
+    def _grid_charge_rows(self, b: LPBuilder, ctx: WindowContext) -> None:
+        """PV grid_charge=0: storage may only charge from PV output —
+        sum(ESS charge) <= sum(PV generation) per timestep (reference:
+        storagevet PV grid-charge constraint surface)."""
+        no_grid_pv = [d for d in self.active_ders
+                      if getattr(d, "grid_charge", True) is False]
+        if not no_grid_pv:
+            return
+        ess_ch = [b[d.vname("ch")] for d in self.active_ders
+                  if d.technology_type == "Energy Storage System"]
+        if not ess_ch:
+            return
+        pv_gen = [b[d.vname("gen")] for d in no_grid_pv]
+        terms = [(r, 1.0) for r in ess_ch] + [(r, -1.0) for r in pv_gen]
+        b.add_rows("grid_charge", terms, "le", 0.0)
+
+    def _requirement_rows(self, b: LPBuilder, ctx: WindowContext,
+                          requirements: List[SystemRequirement]) -> None:
+        """Aggregate energy/charge/discharge min/max profiles (reference:
+        system requirements from storagevet.SystemRequirement applied in the
+        scenario's optimization assembly)."""
+        # merge same (kind, sense) requirements: max of mins, min of maxes
+        merged: Dict[tuple, np.ndarray] = {}
+        for req in requirements:
+            arr = req.window_array(ctx.index)
+            key = (req.kind, req.sense)
+            if key in merged:
+                merged[key] = (np.maximum(merged[key], arr) if req.sense == "min"
+                               else np.minimum(merged[key], arr))
+            else:
+                merged[key] = arr
+        for (kind, sense), arr in merged.items():
+            if not np.isfinite(arr).any():
+                continue
+            arr = np.where(np.isfinite(arr), arr, 0.0 if sense == "min" else 1e30)
+            if kind == "energy":
+                refs = [d.soe_term(b) for d in self.active_ders]
+                terms = [(r, 1.0) for r in refs if r is not None]
+            elif kind in ("charge", "discharge"):
+                terms = []
+                for d in self.active_ders:
+                    for ref, sign in d.power_terms(b):
+                        want = -1.0 if kind == "charge" else 1.0
+                        if sign == want:
+                            terms.append((ref, 1.0))
+            else:
+                continue
+            if not terms:
+                TellUser.warning(f"system requirement {kind}/{sense} has no "
+                                 "contributing DERs — skipped")
+                continue
+            b.add_rows(f"sysreq_{kind}_{sense}", terms,
+                       "ge" if sense == "min" else "le", arr)
+
+    # ------------------------------------------------------------------
+    def merge_reports(self, index: pd.DatetimeIndex,
+                      ts_data: Optional[pd.DataFrame]) -> pd.DataFrame:
+        """Totals frame (reference: MicrogridPOI.merge_reports columns)."""
+        out = pd.DataFrame(index=index)
+        gen = np.zeros(len(index))
+        load = np.zeros(len(index))
+        storage = np.zeros(len(index))
+        original = np.zeros(len(index))
+        owns = any(d.technology_type == "Load" for d in self.der_list)
+        if self.incl_site_load and not owns and ts_data is not None:
+            from .window import grab_column
+            site = grab_column(ts_data.loc[index], "Site Load (kW)")
+            if site is not None:
+                load += site
+                original += site
+        for der in self.der_list:
+            v = der.variables_df
+            if der.technology_type == "Energy Storage System" and v is not None:
+                storage += (v["dis"] - v["ch"]).to_numpy()
+            g = der.generation_series()
+            if g is not None:
+                gen += np.asarray(g)
+            l = der.load_series()
+            if l is not None:
+                load += np.asarray(l)
+            orig = getattr(der, "original_load", None)
+            if orig is not None:
+                original += np.asarray(orig)
+        out["Total Generation (kW)"] = gen
+        out["Total Load (kW)"] = load
+        out["Total Original Load (kW)"] = original
+        out["Total Storage Power (kW)"] = storage
+        out["Net Load (kW)"] = load - gen - storage
+        agg_soe = np.zeros(len(index))
+        any_soe = False
+        for der in self.der_list:
+            v = der.variables_df
+            if v is not None and "ene" in v:
+                agg_soe += v["ene"].to_numpy()
+                any_soe = True
+        if any_soe:
+            out["Aggregated State of Energy (kWh)"] = agg_soe
+        return out
+
+    def sizing_summary(self) -> pd.DataFrame:
+        rows = [d.sizing_summary() for d in self.der_list]
+        rows = [r for r in rows if r]
+        df = pd.DataFrame(rows)
+        if "DER" in df.columns:
+            df = df.set_index("DER")
+        return df

@@ -1,0 +1,245 @@
+"""Scenario runtime: the per-case orchestrator and batched dispatch loop.
+
+Re-designs dervet/MicrogridScenario.py + the storagevet Scenario surface
+(reference :281-346 solves windows one CVXPY problem at a time).  The
+TPU-native difference: optimization windows are grouped by length, every
+same-length group shares one compiled LP structure (K fixed, c/q/l/u per
+window) and solves as a SINGLE batched PDHG call — 12 monthly windows
+become 3 batched solves (31/30/28-day groups), a multi-year sensitivity
+run becomes a few large batches instead of hundreds of solver calls.
+
+Backend 'jax' runs the batched PDHG kernel (TPU when available); backend
+'cpu' runs scipy/HiGHS per window for cross-validation — the reference's
+GLPK role.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from ..io.params import CaseParams
+from ..models.der.base import DER
+from ..models.der.ess import Battery
+from ..models.streams.base import ValueStream
+from ..models.streams.da import DAEnergyTimeShift
+from ..ops.lp import LP, LPBuilder
+from ..ops import cpu_ref
+from ..utils.errors import (ParameterError, SolverError, TellUser,
+                            TimeseriesDataError)
+from .aggregator import ServiceAggregator
+from .poi import POI
+from .window import WindowContext, group_by_length, make_windows
+
+
+def _build_tech_map():
+    """Tag -> constructor(keys, scenario, der_id, datasets).  Populated as
+    technologies land; mirrors TECH_CLASS_MAP at MicrogridScenario.py:71-82."""
+    from ..models.der.pv import PV
+    from ..models.der.generators import CT, CHP, ICE, DieselGenset
+    from ..models.der.load import ControllableLoad
+    from ..models.der.ev import ElectricVehicle1, ElectricVehicle2
+    from ..models.der.caes import CAES
+
+    def battery(keys, scenario, der_id, datasets):
+        return Battery(keys, scenario, der_id, cycle_life=datasets.cycle_life)
+
+    def simple(cls):
+        return lambda keys, scenario, der_id, datasets: cls(keys, scenario, der_id, datasets)
+
+    return {
+        "Battery": battery,
+        "CAES": simple(CAES),
+        "PV": simple(PV),
+        "ICE": simple(ICE),
+        "DieselGenset": simple(DieselGenset),
+        "CT": simple(CT),
+        "CHP": simple(CHP),
+        "Load": simple(ControllableLoad),
+        "ElectricVehicle1": simple(ElectricVehicle1),
+        "ElectricVehicle2": simple(ElectricVehicle2),
+    }
+
+
+def _build_vs_map():
+    """Tag -> ValueStream class; mirrors VS_CLASS_MAP (MicrogridScenario.py:83-98)."""
+    from ..models.streams import registry
+    return registry()
+
+
+class MicrogridScenario:
+    """One sensitivity case: DER fleet + value streams + dispatch loop."""
+
+    def __init__(self, case: CaseParams):
+        self.case = case
+        self.scenario = case.scenario
+        self.dt = float(self.scenario.get("dt", 1))
+        self.n = self.scenario.get("n", "year")
+        opt_years = self.scenario.get("opt_years", [])
+        self.opt_years = [int(y) for y in
+                          (opt_years if isinstance(opt_years, list) else [opt_years])]
+        self.start_year = int(self.scenario.get("start_year", self.opt_years[0]))
+        self.end_year = int(self.scenario.get("end_year", self.opt_years[-1]))
+        self.incl_binary = bool(self.scenario.get("binary", False))
+        self.opt_engine = True
+
+        ts = case.datasets.time_series
+        if ts is None:
+            raise TimeseriesDataError("a time_series_filename is required")
+        keep = ts.index.year.isin(self.opt_years)
+        ts = ts.loc[keep]
+        if not len(ts):
+            raise TimeseriesDataError(
+                f"time series has no data for opt_years {self.opt_years}")
+        self.time_series = ts
+        self.index = ts.index
+        steps_per_hour = round(1 / self.dt)
+        for yr in self.opt_years:
+            n_steps = int((self.index.year == yr).sum())
+            from .window import hours_in_year
+            expected = int(hours_in_year(yr) / self.dt)
+            if n_steps not in (expected, 8760 * steps_per_hour):
+                raise TimeseriesDataError(
+                    f"year {yr}: {n_steps} steps in time series, expected "
+                    f"{expected} at dt={self.dt}")
+
+        self.ders: List[DER] = []
+        tech_map = _build_tech_map()
+        for tag, der_id, keys in case.ders:
+            ctor = tech_map.get(tag)
+            if ctor is None:
+                raise ParameterError(f"unknown DER technology tag {tag!r}")
+            self.ders.append(ctor(keys, self.scenario, der_id, case.datasets))
+
+        vs_map = _build_vs_map()
+        self.streams: Dict[str, ValueStream] = {}
+        for tag, keys in case.streams.items():
+            cls = vs_map.get(tag)
+            if cls is None:
+                raise ParameterError(f"unknown value stream tag {tag!r}")
+            self.streams[tag] = cls(keys, self.scenario, case.datasets)
+
+        self.poi = POI(self.scenario, self.ders)
+        self.service_agg = ServiceAggregator(self.streams)
+        self.windows = make_windows(self.index, self.time_series,
+                                    case.datasets.monthly, self.n, self.dt)
+        self.objective_values: Dict[int, Dict[str, float]] = {}
+        self.solve_metadata: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------
+    def build_window_lp(self, ctx: WindowContext, annuity_scalar: float = 1.0,
+                        requirements=None) -> LP:
+        ctx.annuity_scalar = annuity_scalar
+        b = LPBuilder()
+        self.poi.grab_active_ders(ctx.year)
+        ctx.fixed_load = self.poi.site_load(ctx)
+        for der in self.poi.active_ders:
+            der.build(b, ctx)
+        self.service_agg.build(b, ctx, self.poi.active_ders)
+        self.poi.build(b, ctx, requirements or [])
+        return b.build()
+
+    # ------------------------------------------------------------------
+    def optimize_problem_loop(self, backend: str = "jax",
+                              solver_opts=None) -> None:
+        """Group windows by length, batch-solve each group, scatter results."""
+        t0 = time.time()
+        requirements = self.service_agg.identify_system_requirements(
+            self.ders, self.opt_years, self.index)
+        annuity_scalar = 1.0
+        if self.poi.is_sizing_optimization:
+            annuity_scalar = self.solve_metadata.get("annuity_scalar", 1.0)
+        if not self.opt_engine:
+            return
+
+        # per-variable full-horizon arrays, filled window by window
+        solution: Dict[str, np.ndarray] = {}
+        groups = group_by_length(self.windows)
+        n_solves = 0
+        for T, ctxs in sorted(groups.items()):
+            built = [(ctx, self.build_window_lp(ctx, annuity_scalar, requirements))
+                     for ctx in ctxs]
+            # sub-group by exact K structure (pattern AND values): only
+            # windows whose constraint matrix is byte-identical may share a
+            # compiled solver — data-dependent structure (e.g. EV plug
+            # sessions) falls into its own sub-group automatically
+            subgroups: Dict[int, list] = {}
+            for ctx, lp in built:
+                key = hash((lp.K.shape, lp.K.indptr.tobytes(),
+                            lp.K.indices.tobytes(), lp.K.data.tobytes()))
+                subgroups.setdefault(key, []).append((ctx, lp))
+            for pairs in subgroups.values():
+                self._solve_subgroup(pairs, backend, solver_opts, solution)
+                n_solves += 1
+        self._scatter_to_ders(solution)
+        self.solve_metadata.update({
+            "backend": backend,
+            "solve_seconds": time.time() - t0,
+            "batched_solves": n_solves,
+            "n_windows": len(self.windows),
+        })
+
+    def _solve_subgroup(self, pairs, backend, solver_opts,
+                        solution: Dict[str, np.ndarray]) -> None:
+        ctxs = [p[0] for p in pairs]
+        lps = [p[1] for p in pairs]
+        xs, objs, ok = self._solve_group(lps[0], lps, backend, solver_opts)
+        for ctx, lp, x, obj, converged in zip(ctxs, lps, xs, objs, ok):
+            if not converged:
+                TellUser.error(
+                    f"window {ctx.label} ({ctx.index[0]}..{ctx.index[-1]}) "
+                    f"did not converge")
+                raise SolverError(
+                    f"optimization window {ctx.label} failed to solve; "
+                    f"see log for diagnosis")
+            self.objective_values[ctx.label] = {
+                "Total Objective": float(obj) + lp.c0}
+            pos = np.searchsorted(self.index, ctx.index[0])
+            for name, ref in lp.var_refs.items():
+                if name not in solution:
+                    solution[name] = np.zeros(len(self.index))
+                solution[name][pos:pos + ctx.T] = x[ref.sl]
+
+    def _solve_group(self, lp0: LP, lps: List[LP], backend: str, solver_opts):
+        if backend == "cpu":
+            xs, objs, ok = [], [], []
+            for lp in lps:
+                res = cpu_ref.solve_lp_cpu(lp)
+                xs.append(res.x)
+                objs.append(res.obj)
+                ok.append(res.status == 0)
+            return xs, objs, ok
+        from ..ops.pdhg import CompiledLPSolver, PDHGOptions
+        solver = CompiledLPSolver(lp0, solver_opts or PDHGOptions())
+        if len(lps) == 1:
+            res = solver.solve()
+            return ([np.asarray(res.x)], [float(res.obj)],
+                    [bool(res.converged)])
+        C = np.stack([lp.c for lp in lps])
+        Q = np.stack([lp.q for lp in lps])
+        L = np.stack([lp.l for lp in lps])
+        U = np.stack([lp.u for lp in lps])
+        res = solver.solve(c=C, q=Q, l=L, u=U)
+        return (list(np.asarray(res.x)), list(np.asarray(res.obj)),
+                list(np.asarray(res.converged)))
+
+    def _scatter_to_ders(self, solution: Dict[str, np.ndarray]) -> None:
+        for der in self.ders:
+            prefix = f"{der.tag}-{der.id or '1'}/"
+            values = {name[len(prefix):]: arr
+                      for name, arr in solution.items()
+                      if name.startswith(prefix)}
+            if values:
+                der.store_dispatch(self.index, values)
+
+    # ------------------------------------------------------------------
+    def timeseries_results(self) -> pd.DataFrame:
+        frames = [self.poi.merge_reports(self.index, self.time_series)]
+        for der in self.ders:
+            if der.variables_df is not None:
+                frames.append(der.timeseries_report())
+        frames.append(self.service_agg.timeseries_report(self.index))
+        out = pd.concat(frames, axis=1)
+        return out.reindex(sorted(out.columns), axis=1)

@@ -1,0 +1,131 @@
+"""Optimization-window partitioning and per-window data context.
+
+TPU-native re-design of the reference's ``optimization_levels`` machinery
+(reference: storagevet.Scenario builds a DataFrame with a ``predictive``
+window label per timestep; dervet/MicrogridScenario.py:310 iterates
+``optimization_levels.predictive.unique()`` and solves windows one at a
+time).  Here windows are first-class objects that are *grouped by length*
+so that every same-length window shares one compiled LP structure and the
+whole group solves as a single batched PDHG call on the TPU.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from ..utils.errors import TimeseriesDataError
+
+
+def hours_in_year(year: int) -> int:
+    return 8784 if pd.Timestamp(year=year, month=1, day=1).is_leap_year else 8760
+
+
+def build_optimization_levels(index: pd.DatetimeIndex, n, dt: float) -> pd.Series:
+    """Assign every timestep a window label.
+
+    ``n``: 'year' -> one window per calendar year; 'month' -> one per
+    calendar month; int -> chunks of ``n`` hours within each year
+    (reference semantics: 019-DA_battery_month_12hropt.csv uses n=12 for
+    12-hour windows).
+    """
+    if isinstance(n, str):
+        key = n.strip().lower()
+        if key == "year":
+            labels = index.year.astype(np.int64)
+        elif key == "month":
+            labels = index.year.astype(np.int64) * 100 + index.month.astype(np.int64)
+        else:
+            raise TimeseriesDataError(f"unrecognized optimization window n={n!r}")
+        codes = pd.Series(labels, index=index)
+    else:
+        steps = int(round(float(n) / dt))
+        if steps <= 0:
+            raise TimeseriesDataError(f"optimization window n={n} must be positive")
+        codes = pd.Series(0, index=index, dtype=np.int64)
+        for yr in sorted(set(index.year)):
+            mask = index.year == yr
+            within = np.arange(int(mask.sum())) // steps
+            codes.loc[mask] = yr * 100_000 + within
+    # renumber to consecutive ints in time order
+    uniq = codes.unique()
+    remap = {lab: i for i, lab in enumerate(uniq)}
+    return codes.map(remap)
+
+
+def grab_column(ts: pd.DataFrame, name: str, der_id: str = "",
+                default: Optional[float] = None) -> Optional[np.ndarray]:
+    """Fetch a time-series column, tolerating the reference's per-instance
+    '/<id>' suffixes and case differences (reference: storagevet
+    Params.grab_column surface, SURVEY.md §2.8)."""
+    candidates = [name]
+    if der_id:
+        candidates = [f"{name}/{der_id}", name]
+    lower = {c.strip().lower(): c for c in ts.columns}
+    for cand in candidates:
+        col = lower.get(cand.strip().lower())
+        if col is not None:
+            return ts[col].to_numpy(dtype=np.float64)
+    if default is not None:
+        return np.full(len(ts), float(default))
+    return None
+
+
+@dataclasses.dataclass
+class WindowContext:
+    """Everything a component needs to emit its LP blocks for one window."""
+
+    label: int                     # window number (time-ordered)
+    index: pd.DatetimeIndex        # hour-beginning timestep index
+    ts: pd.DataFrame               # time-series slice for this window
+    monthly: Optional[pd.DataFrame]   # full monthly dataset (Year, Month idx)
+    dt: float
+    annuity_scalar: float = 1.0
+    # total constant load (site load + DER fixed loads), set by the POI at
+    # assembly time so value streams price it exactly once
+    fixed_load: Optional[np.ndarray] = None
+    # mutable per-window state handed between windows (e.g. battery SOE
+    # carry, degraded energy capacity) keyed by component unique id
+    carry: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def T(self) -> int:
+        return len(self.index)
+
+    @property
+    def year(self) -> int:
+        return int(self.index[0].year)
+
+    def col(self, name: str, der_id: str = "", default=None):
+        return grab_column(self.ts, name, der_id, default)
+
+    def monthly_value(self, column: str, default=None):
+        """Look up a monthly-data value for this window's (year, month)."""
+        if self.monthly is None:
+            return default
+        key = (self.year, int(self.index[0].month))
+        try:
+            return float(self.monthly.loc[key, column])
+        except KeyError:
+            return default
+
+
+def make_windows(index: pd.DatetimeIndex, ts: pd.DataFrame, monthly,
+                 n, dt: float) -> List[WindowContext]:
+    levels = build_optimization_levels(index, n, dt)
+    out = []
+    for label in levels.unique():
+        mask = (levels == label).to_numpy()
+        sub = index[mask]
+        out.append(WindowContext(label=int(label), index=sub, ts=ts.loc[sub],
+                                 monthly=monthly, dt=dt))
+    return out
+
+
+def group_by_length(windows: List[WindowContext]) -> Dict[int, List[WindowContext]]:
+    groups: Dict[int, List[WindowContext]] = {}
+    for w in windows:
+        groups.setdefault(w.T, []).append(w)
+    return groups

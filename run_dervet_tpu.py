@@ -1,0 +1,41 @@
+"""CLI entry point (mirrors reference run_DERVET.py:73-92).
+
+Usage:  python run_dervet_tpu.py <model_parameters.csv> [-v] [--backend jax|cpu]
+                                 [--base-path DIR] [--out DIR]
+"""
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from dervet_tpu.api import DERVET
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="run_dervet_tpu",
+        description="TPU-native DER valuation: dispatch optimization, sizing, "
+                    "reliability, and cost-benefit analysis")
+    parser.add_argument("parameters_filename",
+                        help="model parameters CSV/JSON file")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("--backend", default="jax", choices=["jax", "cpu"],
+                        help="dispatch solver backend (jax = batched PDHG on "
+                             "TPU; cpu = scipy HiGHS cross-validation path)")
+    parser.add_argument("--base-path", default=None,
+                        help="root for relative referenced-data paths "
+                             "(default: the parameters file's directory)")
+    parser.add_argument("--out", default=None,
+                        help="override results output directory")
+    args = parser.parse_args(argv)
+
+    case = DERVET(args.parameters_filename, verbose=args.verbose,
+                  base_path=args.base_path)
+    results = case.solve(backend=args.backend)
+    results.save_as_csv(args.out)
+    return results
+
+
+if __name__ == "__main__":
+    main()
