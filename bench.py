@@ -1,0 +1,100 @@
+"""North-star benchmark: N price scenarios x one year of Battery+PV+DA
+dispatch (monthly windows), batched PDHG on the default JAX device.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": ...}
+
+``vs_baseline`` compares against the BASELINE.json target (1000 scenarios
+x 8760-h Battery+PV in < 60 s): values > 1.0 beat the target.
+
+The measured number is the steady-state wall time of the batched solves
+(all 12 monthly windows x all scenarios), after one warm-up pass that
+pays XLA compilation.  Host-side LP assembly happens once per window
+structure and is reported separately on stderr.
+
+Env knobs: BENCH_SCENARIOS (default 1000).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_SECONDS = 60.0
+BASELINE_SCENARIOS = 1000
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    from dervet_tpu.benchlib import (build_window_lps, scenario_price_batch,
+                                     synthetic_case)
+    from dervet_tpu.ops.pdhg import CompiledLPSolver, PDHGOptions
+
+    n_scen = int(os.environ.get("BENCH_SCENARIOS", BASELINE_SCENARIOS))
+    dev = jax.devices()[0]
+    log(f"bench: device={dev.platform}:{dev.device_kind} scenarios={n_scen}")
+
+    t0 = time.time()
+    case = synthetic_case()
+    scen, groups = build_window_lps(case)
+    log(f"bench: assembled {sum(len(v) for v in groups.values())} windows "
+        f"({len(groups)} length groups) in {time.time() - t0:.1f}s")
+
+    # one compiled solver per length group; batch = windows-in-group x scenarios
+    jobs = []
+    for T, lps in sorted(groups.items()):
+        solver = CompiledLPSolver(lps[0], PDHGOptions())
+        C = np.concatenate([
+            scenario_price_batch(lp, n_scen, seed=17) for lp in lps])
+        Q = np.repeat(np.stack([lp.q for lp in lps]), n_scen, axis=0)
+        L = np.repeat(np.stack([lp.l for lp in lps]), n_scen, axis=0)
+        U = np.repeat(np.stack([lp.u for lp in lps]), n_scen, axis=0)
+        jobs.append((T, solver, C, Q, L, U))
+        log(f"bench: group T={T}: {len(lps)} windows x {n_scen} scenarios "
+            f"-> batch {C.shape[0]}, n={lps[0].n}, m={lps[0].m}")
+
+    def run_all():
+        results = []
+        for T, solver, C, Q, L, U in jobs:
+            res = solver.solve(c=C, q=Q, l=L, u=U)
+            results.append(res)
+        # block on everything
+        for res in results:
+            res.obj.block_until_ready()
+        return results
+
+    t0 = time.time()
+    run_all()
+    warm = time.time() - t0
+    log(f"bench: warm-up (incl. XLA compile): {warm:.1f}s")
+
+    t0 = time.time()
+    results = run_all()
+    elapsed = time.time() - t0
+
+    n_total = sum(int(np.asarray(r.converged).size) for r in results)
+    n_conv = sum(int(np.asarray(r.converged).sum()) for r in results)
+    max_it = max(int(np.asarray(r.iters).max()) for r in results)
+    log(f"bench: steady-state {elapsed:.2f}s; {n_conv}/{n_total} window-LPs "
+        f"converged, worst iters {max_it}")
+
+    # scale the target linearly if running fewer scenarios than the baseline
+    baseline = BASELINE_SECONDS * n_scen / BASELINE_SCENARIOS
+    print(json.dumps({
+        "metric": f"battery_pv_da_year_dispatch_{n_scen}scen_s",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(baseline / elapsed, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

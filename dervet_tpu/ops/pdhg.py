@@ -9,28 +9,46 @@ we solve the canonical-form LP
 
     min c@x   s.t.  (K@x - q)[:n_eq] == 0,  (K@x - q)[n_eq:] >= 0,  l<=x<=u
 
-with primal-dual hybrid gradient — a few dense matvecs per iteration, which
-XLA maps straight onto the MXU — and ``jax.vmap`` over the scenario axis
-(sensitivity cases / sizing sweeps / Monte-Carlo draws) so thousands of
-scenarios solve simultaneously.  ``K`` is shared across the batch; only
-``c, q, l, u`` vary per scenario.
+with primal-dual hybrid gradient — a few matvecs per iteration — and
+``jax.vmap`` over the scenario axis (sensitivity cases / sizing sweeps /
+Monte-Carlo draws) so thousands of scenarios solve simultaneously.  ``K``
+is shared across the batch; only ``c, q, l, u`` vary per scenario.
+
+Two matvec backends, chosen automatically by problem size:
+
+* **dense** — ``K`` as a dense (m, n) array; XLA maps the batched matvec
+  straight onto the MXU.  Best for small windows where the dense matmul is
+  a single fused MXU op.
+* **ELL sparse** — dispatch LPs are >99% sparse (SOE bidiagonals, diagonal
+  coupling rows), so for large windows the dense form is HBM-infeasible
+  (a 8760-step Battery+PV window is ~1 GB for K alone).  We pad rows to a
+  fixed nnz-per-row (ELLPACK) and compute ``Kx[i] = sum_k data[i,k] *
+  x[cols[i,k]]`` — one gather + elementwise FMA, all static shapes, no
+  scatter.  ``K^T`` gets its own ELL table.  FLOPs and bytes drop from
+  O(m*n) to O(nnz).
 
 Algorithmic ingredients (see PAPERS.md: PDLP / MPAX): Ruiz l-inf
 equilibration, step size from a power-iteration bound on ||K||2, iterate
-averaging, adaptive restarts on the KKT score, and primal-weight updates on
-restart.
+averaging, adaptive restarts on the KKT score, primal-weight updates on
+restart, and primal-infeasibility certificates from the normalized dual
+ray (early exit instead of burning max_iters on infeasible windows).
 """
 from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .lp import LP
+
+# status codes (PDHGResult.status)
+STATUS_CONVERGED = 0
+STATUS_ITER_LIMIT = 1
+STATUS_PRIMAL_INFEASIBLE = 2
 
 
 # ---------------------------------------------------------------------------
@@ -59,6 +77,65 @@ def ruiz_scaling(K, iters: int = 10):
 
 
 # ---------------------------------------------------------------------------
+# Matvec operators (dense | ELL sparse), vmap/jit-friendly pytrees
+# ---------------------------------------------------------------------------
+
+class DenseOp(NamedTuple):
+    Kh: jax.Array            # (m, n)
+
+
+class EllOp(NamedTuple):
+    data: jax.Array          # (m, k)  row-padded values
+    cols: jax.Array          # (m, k)  int32 column ids (pad -> 0, data 0)
+    data_t: jax.Array        # (n, kt) transpose table
+    cols_t: jax.Array        # (n, kt)
+
+
+MatOp = Union[DenseOp, EllOp]
+
+
+def _csr_to_ell(K) -> tuple[np.ndarray, np.ndarray]:
+    """CSR -> ELLPACK (data, cols) with rows padded to the max row nnz."""
+    K = K.tocsr()
+    m = K.shape[0]
+    counts = np.diff(K.indptr)
+    k = max(int(counts.max()) if m else 0, 1)
+    data = np.zeros((m, k), np.float64)
+    cols = np.zeros((m, k), np.int32)
+    rows = np.repeat(np.arange(m), counts)
+    pos = np.arange(K.nnz) - np.repeat(K.indptr[:-1], counts)
+    data[rows, pos] = K.data
+    cols[rows, pos] = K.indices
+    return data, cols
+
+
+def make_op(K_scaled, dense_bytes_limit: int = 32 * 1024 * 1024,
+            dtype=jnp.float32) -> MatOp:
+    """Pick dense vs ELL for the (already Ruiz-scaled) constraint matrix."""
+    m, n = K_scaled.shape
+    if m * n * jnp.dtype(dtype).itemsize <= dense_bytes_limit:
+        return DenseOp(Kh=jnp.asarray(K_scaled.todense(), dtype))
+    d, c = _csr_to_ell(K_scaled)
+    dt, ct = _csr_to_ell(K_scaled.T.tocsr())
+    return EllOp(data=jnp.asarray(d, dtype), cols=jnp.asarray(c),
+                 data_t=jnp.asarray(dt, dtype), cols_t=jnp.asarray(ct))
+
+
+def op_matvec(op: MatOp, x: jax.Array, prec) -> jax.Array:
+    """K @ x (scaled space)."""
+    if isinstance(op, DenseOp):
+        return jnp.matmul(op.Kh, x, precision=prec)
+    return jnp.sum(op.data * x[op.cols], axis=-1)
+
+
+def op_rmatvec(op: MatOp, y: jax.Array, prec) -> jax.Array:
+    """K.T @ y (scaled space)."""
+    if isinstance(op, DenseOp):
+        return jnp.matmul(op.Kh.T, y, precision=prec)
+    return jnp.sum(op.data_t * y[op.cols_t], axis=-1)
+
+
+# ---------------------------------------------------------------------------
 # Options / results
 # ---------------------------------------------------------------------------
 
@@ -76,6 +153,12 @@ class PDHGOptions:
     power_iters: int = 40
     ruiz_iters: int = 10
     step_size_safety: float = 0.99
+    # infeasibility detection: declare primal-infeasible when the normalized
+    # dual ray certifies a positive Farkas gap this many checks in a row
+    infeas_checks: int = 4
+    eps_infeas: float = 1e-6
+    # switch K to ELLPACK above this dense-size threshold
+    dense_bytes_limit: int = 32 * 1024 * 1024
     dtype: jnp.dtype = jnp.float32
     # TPU MXU default precision is bf16, which is NOT enough for PDHG to
     # converge (the iteration amplifies matvec rounding through the box
@@ -91,6 +174,7 @@ class PDHGResult(NamedTuple):
     iters: jax.Array      # (...,)   iterations used
     prim_res: jax.Array   # (...,)   final primal residual (inf norm)
     gap: jax.Array        # (...,)   final |primal-dual| gap
+    status: jax.Array     # (...,)   int32 STATUS_* code
 
 
 class _State(NamedTuple):
@@ -109,21 +193,23 @@ class _State(NamedTuple):
     done_x: jax.Array       # frozen solution once converged
     done_y: jax.Array
     iters_at_conv: jax.Array
+    infeas_streak: jax.Array   # consecutive checks certifying infeasibility
+    infeasible: jax.Array      # primal infeasibility declared
 
 
 # ---------------------------------------------------------------------------
 # Core solver on the *scaled* problem, structured for jit + vmap
 # ---------------------------------------------------------------------------
 
-def _kkt_terms(Kh, x, y, c, q, l, u, eq_mask, dr, dc, prec):
+def _kkt_terms(op, x, y, c, q, l, u, eq_mask, dr, dc, prec):
     """Residuals/objectives of the UNSCALED problem given scaled iterates.
 
     x_unscaled = dc * x, y_unscaled = dr * y; K = D_r^-1 Kh D_c^-1.
     """
     xu = dc * x
     yu = dr * y
-    Kx = jnp.matmul(Kh, x, precision=prec) / dr        # = K @ xu
-    KTy = jnp.matmul(Kh.T, y, precision=prec) / dc     # = K.T @ yu
+    Kx = op_matvec(op, x, prec) / dr        # = K @ xu
+    KTy = op_rmatvec(op, y, prec) / dc      # = K.T @ yu
     r = q - Kx
     viol = jnp.where(eq_mask, jnp.abs(r), jnp.maximum(r, 0.0))
     prim_res = jnp.max(viol) if viol.size else jnp.asarray(0.0, x.dtype)
@@ -149,22 +235,50 @@ def _converged(prim_res, dual_res, gap, pobj, dobj, q_norm, c_norm, opts):
     return ok_p & ok_d & ok_g
 
 
+def _farkas_gap(op, y, q, l, u, eq_mask, dr, dc, prec, dtype):
+    """Primal-infeasibility certificate quality of the dual direction ``y``.
+
+    The primal (min c@x : Kx - q in {0}^eq x R+^ineq, l<=x<=u) is infeasible
+    iff some y with y_ineq >= 0 has  q@y > max_{l<=x<=u} (K^T y)@x.  We test
+    the normalized current dual iterate (which converges to a Farkas ray on
+    infeasible problems).  Returns (gap, ray_violation): a certificate is
+    valid when gap > eps and ray_violation <= eps.
+    """
+    yu = dr * y
+    ynorm = jnp.linalg.norm(yu)
+    yhat = yu / jnp.maximum(ynorm, jnp.asarray(1e-12, dtype))
+    KTy = op_rmatvec(op, y, prec) / dc / jnp.maximum(ynorm, 1e-12)  # K^T yhat
+    pos = jnp.maximum(KTy, 0.0)
+    neg = jnp.minimum(KTy, 0.0)
+    l_fin = jnp.isfinite(l)
+    u_fin = jnp.isfinite(u)
+    # positive reduced mass on u=inf (or negative on l=-inf) components makes
+    # the box maximum infinite -> the ray is invalid by that much
+    ray_viol = jnp.sum(jnp.where(u_fin, 0.0, pos) - jnp.where(l_fin, 0.0, neg))
+    box_max = jnp.sum(jnp.where(u_fin, pos * u, 0.0)
+                      + jnp.where(l_fin, neg * l, 0.0))
+    gap = q @ yhat - box_max
+    return gap, ray_viol, ynorm
+
+
 def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
-    """Build the jittable scaled-space solve(Kh, c, q, l, u, dr, dc, eta)."""
+    """Build the jittable scaled-space solve(op, c, q, l, u, dr, dc, eta)."""
 
     prec = opts.precision
 
-    def one_iter(carry, _, Kh, c, q, l, u, eq_mask, omega, eta):
-        x, y = carry
+    def one_iter(carry, _, op, c, q, l, u, eq_mask, omega, eta):
+        # running sums in the carry (NOT stacked trajectories — a stacked
+        # scan would materialize check_every x batch x n floats)
+        x, y, x_sum, y_sum = carry
         tau = eta / omega
         sigma = eta * omega
-        grad = c - jnp.matmul(Kh.T, y, precision=prec)
+        grad = c - op_rmatvec(op, y, prec)
         x1 = jnp.clip(x - tau * grad, l, u)
-        y1 = y + sigma * (q - jnp.matmul(Kh, 2.0 * x1 - x, precision=prec))
+        y1 = y + sigma * (q - op_matvec(op, 2.0 * x1 - x, prec))
         y1 = jnp.where(eq_mask, y1, jnp.maximum(y1, 0.0))
-        return (x1, y1), (x1, y1)
+        return (x1, y1, x_sum + x1, y_sum + y1), None
 
-    def solve(Kh, c, q, l, u, dr, dc, eta):
+    def solve(op, c, q, l, u, dr, dc, eta):
         dtype = opts.dtype
         eq_mask = jnp.arange(m) < n_eq
         # scale problem data into the preconditioned space
@@ -180,9 +294,20 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
         l_us = l.astype(dtype)
         u_us = u.astype(dtype)
 
+        # zero scalar *derived from the problem data* so that, under
+        # shard_map, every loop-carried value inherits the data's
+        # varying-over-mesh-axis type (plain constants would not and the
+        # scan/while carries would type-mismatch)
+        fzero = (jnp.sum(c_s) + jnp.sum(q_s)
+                 + jnp.sum(jnp.where(jnp.isfinite(l_s), l_s, 0.0))
+                 + jnp.sum(jnp.where(jnp.isfinite(u_s), u_s, 0.0))) * 0.0
+        fzero = fzero.astype(dtype)
+        izero = fzero.astype(jnp.int32)
+        bfalse = fzero > 1.0
+
         # start at the projection of 0 onto the box, in the scaled space
-        x0 = jnp.clip(jnp.zeros(n, dtype), l_s, u_s)
-        y0 = jnp.zeros(m, dtype)
+        x0 = jnp.clip(jnp.zeros(n, dtype) + fzero, l_s, u_s)
+        y0 = jnp.zeros(m, dtype) + fzero
 
         # primal weight: ratio of objective to rhs magnitude in the scaled
         # space (PDLP's initialization) — battery LPs have tiny $-valued
@@ -195,7 +320,7 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
         omega_hi = omega0 * 50.0
 
         def check_scores(x, y):
-            return _kkt_terms(Kh, x, y, c_us, q_us, l_us, u_us, eq_mask, dr, dc,
+            return _kkt_terms(op, x, y, c_us, q_us, l_us, u_us, eq_mask, dr, dc,
                               prec)
 
         def mu_of(x, y):
@@ -204,16 +329,14 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
             return jnp.sqrt(pr * pr + dr_ * dr_ + (gp / denom) ** 2), (pr, dr_, gp, po, do)
 
         def cond(s: _State):
-            return (~jnp.all(s.converged)) & (s.total < opts.max_iters)
+            return (~jnp.all(s.converged)) & (~s.infeasible) \
+                & (s.total < opts.max_iters)
 
         def body(s: _State):
-            (x, y), traj = jax.lax.scan(
-                functools.partial(one_iter, Kh=Kh, c=c_s, q=q_s, l=l_s, u=u_s,
+            (x, y, x_sum, y_sum), _ = jax.lax.scan(
+                functools.partial(one_iter, op=op, c=c_s, q=q_s, l=l_s, u=u_s,
                                   eq_mask=eq_mask, omega=s.omega, eta=eta),
-                (s.x, s.y), None, length=opts.check_every)
-            xs, ys = traj
-            x_sum = s.x_sum + jnp.sum(xs, axis=0)
-            y_sum = s.y_sum + jnp.sum(ys, axis=0)
+                (s.x, s.y, s.x_sum, s.y_sum), None, length=opts.check_every)
             inner = s.inner + opts.check_every
             total = s.total + opts.check_every
             x_avg = x_sum / inner.astype(x.dtype)
@@ -229,6 +352,16 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
                 lambda a, b: jnp.where(use_avg, a, b), avg_terms, cur_terms)
 
             conv_now = _converged(pr, dr_, gp, po, do, q_norm, c_norm, opts)
+
+            # primal-infeasibility certificate on the current dual direction
+            fk_gap, fk_viol, ynorm = _farkas_gap(
+                op, y, q_us, l_us, u_us, eq_mask, dr, dc, prec, dtype)
+            scale_ref = 1.0 + q_norm
+            cert = ((fk_gap > opts.eps_infeas * scale_ref)
+                    & (fk_viol <= opts.eps_infeas * scale_ref)
+                    & (ynorm > 1.0) & ~conv_now)
+            streak = jnp.where(cert, s.infeas_streak + 1, 0)
+            infeasible = streak >= opts.infeas_checks
 
             do_restart = (
                 (mu_cand <= opts.beta_sufficient * s.mu_restart)
@@ -266,31 +399,39 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
                 done_x=jnp.where(newly, x_cand, s.done_x),
                 done_y=jnp.where(newly, y_cand, s.done_y),
                 iters_at_conv=jnp.where(newly, total, s.iters_at_conv),
+                infeas_streak=streak,
+                infeasible=infeasible,
             )
 
-        big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+        big = jnp.asarray(jnp.finfo(dtype).max, dtype) / 2 + fzero
         init = _State(
             x=x0.astype(dtype), y=y0.astype(dtype),
-            x_sum=jnp.zeros(n, dtype), y_sum=jnp.zeros(m, dtype),
-            inner=jnp.asarray(0, jnp.int32), total=jnp.asarray(0, jnp.int32),
-            omega=omega0,
+            x_sum=jnp.zeros(n, dtype) + fzero, y_sum=jnp.zeros(m, dtype) + fzero,
+            inner=izero, total=izero,
+            omega=omega0 + fzero,
             x_restart=x0.astype(dtype), y_restart=y0.astype(dtype),
             mu_restart=big, mu_prev=big,
-            converged=jnp.asarray(False),
+            converged=bfalse,
             done_x=x0.astype(dtype), done_y=y0.astype(dtype),
-            iters_at_conv=jnp.asarray(opts.max_iters, jnp.int32),
+            iters_at_conv=jnp.asarray(opts.max_iters, jnp.int32) + izero,
+            infeas_streak=izero,
+            infeasible=bfalse,
         )
         final = jax.lax.while_loop(cond, body, init)
         # if never converged, report last iterate
         x_out = jnp.where(final.converged, final.done_x, final.x)
         y_out = jnp.where(final.converged, final.done_y, final.y)
-        pr, dr_, gp, po, do = _kkt_terms(Kh, x_out, y_out, c_us, q_us, l_us, u_us,
+        pr, dr_, gp, po, do = _kkt_terms(op, x_out, y_out, c_us, q_us, l_us, u_us,
                                          eq_mask, dr, dc, prec)
+        status = jnp.where(
+            final.converged, STATUS_CONVERGED,
+            jnp.where(final.infeasible, STATUS_PRIMAL_INFEASIBLE,
+                      STATUS_ITER_LIMIT)).astype(jnp.int32)
         return PDHGResult(
             x=x_out * dc, y=y_out * dr, obj=po,
             converged=final.converged,
             iters=jnp.where(final.converged, final.iters_at_conv, final.total),
-            prim_res=pr, gap=gp,
+            prim_res=pr, gap=gp, status=status,
         )
 
     return solve
@@ -304,8 +445,8 @@ class CompiledLPSolver:
     """Preconditions an LP structure once, then solves (batches of) instances.
 
     ``K`` (structure) is fixed; ``c, q, l, u`` may carry a leading batch
-    dimension.  The heavy per-iteration work is two dense matvecs which XLA
-    turns into MXU matmuls when batched.
+    dimension.  Small structures stay dense (MXU matmuls); large ones switch
+    to ELLPACK gather-matvecs (see module docstring).
     """
 
     def __init__(self, lp: LP, opts: Optional[PDHGOptions] = None):
@@ -313,20 +454,19 @@ class CompiledLPSolver:
         self.lp = lp
         dtype = self.opts.dtype
         d_r, d_c = ruiz_scaling(lp.K, self.opts.ruiz_iters)
-        Kh_sp = lp.K.multiply(d_r[:, None]).multiply(d_c[None, :])
-        self.Kh = jnp.asarray(Kh_sp.todense(), dtype)
+        Kh_sp = lp.K.multiply(d_r[:, None]).multiply(d_c[None, :]).tocsr()
+        self.op = make_op(Kh_sp, self.opts.dense_bytes_limit, dtype)
         self.dr = jnp.asarray(d_r, dtype)
         self.dc = jnp.asarray(d_c, dtype)
         # power iteration for ||Kh||_2
         v = np.random.default_rng(0).standard_normal(lp.n)
         v = jnp.asarray(v / np.linalg.norm(v), dtype)
-        Kh = self.Kh
+        op = self.op
 
         prec = self.opts.precision
 
         def piter(v, _):
-            w = jnp.matmul(Kh.T, jnp.matmul(Kh, v, precision=prec),
-                           precision=prec)
+            w = op_rmatvec(op, op_matvec(op, v, prec), prec)
             nw = jnp.linalg.norm(w)
             return w / jnp.maximum(nw, 1e-30), nw
 
@@ -350,7 +490,7 @@ class CompiledLPSolver:
     def solve(self, c=None, q=None, l=None, u=None) -> PDHGResult:
         c, q, l, u = self._data(c, q, l, u)
         if all(arr.ndim == 1 for arr in (c, q, l, u)):
-            return self._jit_single(self.Kh, c, q, l, u, self.dr, self.dc,
+            return self._jit_single(self.op, c, q, l, u, self.dr, self.dc,
                                     self.eta)
         if any(arr.ndim not in (1, 2) for arr in (c, q, l, u)):
             raise ValueError("solve() inputs must be 1-D (shared) or 2-D (batched)")
@@ -358,14 +498,42 @@ class CompiledLPSolver:
         if len(sizes) > 1:
             raise ValueError(f"inconsistent batch sizes in solve(): {sorted(sizes)}")
         B = sizes.pop()
+        c, q, l, u = self.batch_data(B, c, q, l, u)
+        return self._jit_batch(self.op, c, q, l, u, self.dr, self.dc,
+                               self.eta)
+
+    def batch_data(self, B: int, c, q, l, u):
+        """Broadcast any shared 1-D arrays up to the batch dimension."""
         c = jnp.broadcast_to(c, (B, self.lp.n)) if c.ndim == 1 else c
         q = jnp.broadcast_to(q, (B, self.lp.m)) if q.ndim == 1 else q
         l = jnp.broadcast_to(l, (B, self.lp.n)) if l.ndim == 1 else l
         u = jnp.broadcast_to(u, (B, self.lp.n)) if u.ndim == 1 else u
-        return self._jit_batch(self.Kh, c, q, l, u, self.dr, self.dc,
-                               self.eta)
+        return c, q, l, u
 
 
 def solve_lp(lp: LP, opts: Optional[PDHGOptions] = None) -> PDHGResult:
     """One-shot convenience wrapper."""
     return CompiledLPSolver(lp, opts).solve()
+
+
+def diagnose_infeasibility(lp: LP, y) -> str:
+    """Human-readable infeasibility diagnosis: ranks constraint row groups by
+    their dual-ray weight (the rows driving the Farkas certificate are the
+    conflicting requirements).  ``y`` is the failing instance's dual vector
+    (``res.y``, or ``res.y[i]`` for instance i of a batch).  Mirrors the role
+    of the reference's ``cvx_error_msg`` propagation
+    (dervet/MicrogridScenario.py:319-320)."""
+    y = np.abs(np.asarray(y))
+    if y.ndim > 1:
+        y = y.max(axis=0)
+    total = y.sum() or 1.0
+    weights = []
+    for name, ranges in lp.row_groups.items():
+        w = sum(float(y[a:b].sum()) for a, b in ranges)
+        weights.append((w / total, name))
+    weights.sort(reverse=True)
+    top = [f"{name} ({w:.0%})" for w, name in weights[:4] if w > 0.01]
+    if not top:
+        top = ["no dominant group (dual mass is spread thinly)"]
+    return ("problem is primal infeasible; conflicting constraint groups by "
+            "dual-ray weight: " + ", ".join(top))

@@ -1,0 +1,1 @@
+from .mesh import scenario_mesh, solve_batch_sharded  # noqa: F401

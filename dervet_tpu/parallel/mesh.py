@@ -1,0 +1,110 @@
+"""Multi-chip execution: scenario-axis sharding of the batched LP solve.
+
+The reference is a single-process CPU program (SURVEY.md §2.10); its only
+"parallelism" is a Python for-loop over sensitivity cases (reference:
+dervet/DERVET.py:75-83).  The TPU-native scale-out axis is the scenario
+batch — sensitivity cases x sizing sweeps x Monte-Carlo draws x same-length
+windows — sharded over a 1-D device mesh with ``jax.shard_map``:
+
+* problem *structure* (the ELL/dense K tables, Ruiz scalings, step size) is
+  replicated on every chip — it is identical across the batch;
+* per-scenario data ``c, q, l, u`` is sharded on the leading axis; each chip
+  runs the vmapped PDHG solve on its local shard (compute rides the MXU,
+  zero inter-chip traffic in the hot loop);
+* the only collectives are cheap ``psum`` reductions of convergence
+  statistics — they ride ICI and cost nothing relative to the solve.
+
+This layout is the "pick a mesh, annotate shardings, let XLA insert
+collectives" recipe: dispatch scenarios are embarrassingly parallel, so the
+right multi-chip program keeps them independent and reduces only scalars.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.pdhg import CompiledLPSolver, PDHGResult
+
+AXIS = "scenario"
+
+
+class ShardedStats(NamedTuple):
+    """Globally-reduced (psum) solve statistics."""
+    n_converged: jax.Array   # total converged scenarios across the mesh
+    max_iters: jax.Array     # worst-case iteration count across the mesh
+    max_prim_res: jax.Array  # worst primal residual across the mesh
+
+
+def scenario_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the scenario/batch axis."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devs)} "
+                f"(set XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                f"for CPU testing)")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (AXIS,))
+
+
+def solve_batch_sharded(solver: CompiledLPSolver, mesh: Mesh,
+                        c=None, q=None, l=None, u=None):
+    """Solve a batch of LP instances sharded over ``mesh``.
+
+    Any of ``c/q/l/u`` may be 1-D (shared, replicated) or 2-D batched on the
+    leading axis.  The batch is padded up to a multiple of the mesh size
+    (padding rows replicate the last row) and trimmed from the result;
+    padding rows are masked out of the psum'd statistics.
+
+    Returns ``(PDHGResult, ShardedStats)`` with result arrays batched on the
+    original (un-padded) leading axis.
+    """
+    c, q, l, u = solver._data(c, q, l, u)
+    sizes = {arr.shape[0] for arr in (c, q, l, u) if arr.ndim == 2}
+    if not sizes:
+        raise ValueError("solve_batch_sharded needs at least one batched input")
+    if len(sizes) > 1:
+        raise ValueError(f"inconsistent batch sizes: {sorted(sizes)}")
+    B = sizes.pop()
+    c, q, l, u = solver.batch_data(B, c, q, l, u)
+
+    n_dev = mesh.devices.size
+    B_pad = ((B + n_dev - 1) // n_dev) * n_dev
+    if B_pad != B:
+        c, q, l, u = (jnp.pad(a, [(0, B_pad - B)] + [(0, 0)] * (a.ndim - 1),
+                              mode="edge") for a in (c, q, l, u))
+
+    valid = (jnp.arange(B_pad) < B).astype(jnp.int32)
+
+    vsolve = jax.vmap(solver._solve,
+                      in_axes=(None, 0, 0, 0, 0, None, None, None))
+
+    def local_solve(c, q, l, u, valid):
+        res = vsolve(solver.op, c, q, l, u, solver.dr, solver.dc, solver.eta)
+        stats = ShardedStats(
+            n_converged=jax.lax.psum(
+                jnp.sum(res.converged.astype(jnp.int32) * valid), AXIS),
+            max_iters=jax.lax.pmax(jnp.max(res.iters * valid), AXIS),
+            max_prim_res=jax.lax.pmax(
+                jnp.max(jnp.where(valid == 1, res.prim_res, 0.0)), AXIS),
+        )
+        return res, stats
+
+    shmapped = jax.shard_map(
+        local_solve, mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(PDHGResult(x=P(AXIS), y=P(AXIS), obj=P(AXIS),
+                              converged=P(AXIS), iters=P(AXIS),
+                              prim_res=P(AXIS), gap=P(AXIS), status=P(AXIS)),
+                   ShardedStats(n_converged=P(), max_iters=P(),
+                                max_prim_res=P())),
+    )
+    res, stats = jax.jit(shmapped)(c, q, l, u, valid)
+    if B_pad != B:
+        res = PDHGResult(*(a[:B] for a in res))
+    return res, stats

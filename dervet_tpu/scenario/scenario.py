@@ -185,15 +185,14 @@ class MicrogridScenario:
                         solution: Dict[str, np.ndarray]) -> None:
         ctxs = [p[0] for p in pairs]
         lps = [p[1] for p in pairs]
-        xs, objs, ok = self._solve_group(lps[0], lps, backend, solver_opts)
-        for ctx, lp, x, obj, converged in zip(ctxs, lps, xs, objs, ok):
+        xs, objs, ok, diags = self._solve_group(lps[0], lps, backend, solver_opts)
+        for ctx, lp, x, obj, converged, diag in zip(ctxs, lps, xs, objs, ok,
+                                                    diags):
             if not converged:
-                TellUser.error(
-                    f"window {ctx.label} ({ctx.index[0]}..{ctx.index[-1]}) "
-                    f"did not converge")
-                raise SolverError(
-                    f"optimization window {ctx.label} failed to solve; "
-                    f"see log for diagnosis")
+                msg = (f"window {ctx.label} ({ctx.index[0]}..{ctx.index[-1]}) "
+                       f"did not solve: {diag}")
+                TellUser.error(msg)
+                raise SolverError(msg)
             self.objective_values[ctx.label] = {
                 "Total Objective": float(obj) + lp.c0}
             pos = np.searchsorted(self.index, ctx.index[0])
@@ -204,26 +203,39 @@ class MicrogridScenario:
 
     def _solve_group(self, lp0: LP, lps: List[LP], backend: str, solver_opts):
         if backend == "cpu":
-            xs, objs, ok = [], [], []
+            xs, objs, ok, diags = [], [], [], []
             for lp in lps:
                 res = cpu_ref.solve_lp_cpu(lp)
                 xs.append(res.x)
                 objs.append(res.obj)
                 ok.append(res.status == 0)
-            return xs, objs, ok
-        from ..ops.pdhg import CompiledLPSolver, PDHGOptions
+                diags.append(getattr(res, "message", "") or "solver failure")
+            return xs, objs, ok, diags
+        from ..ops.pdhg import (STATUS_PRIMAL_INFEASIBLE, CompiledLPSolver,
+                                PDHGOptions, diagnose_infeasibility)
         solver = CompiledLPSolver(lp0, solver_opts or PDHGOptions())
         if len(lps) == 1:
             res = solver.solve()
-            return ([np.asarray(res.x)], [float(res.obj)],
-                    [bool(res.converged)])
-        C = np.stack([lp.c for lp in lps])
-        Q = np.stack([lp.q for lp in lps])
-        L = np.stack([lp.l for lp in lps])
-        U = np.stack([lp.u for lp in lps])
-        res = solver.solve(c=C, q=Q, l=L, u=U)
-        return (list(np.asarray(res.x)), list(np.asarray(res.obj)),
-                list(np.asarray(res.converged)))
+            statuses = [int(res.status)]
+            xs = [np.asarray(res.x)]
+            objs = [float(res.obj)]
+            ok = [bool(res.converged)]
+        else:
+            C = np.stack([lp.c for lp in lps])
+            Q = np.stack([lp.q for lp in lps])
+            L = np.stack([lp.l for lp in lps])
+            U = np.stack([lp.u for lp in lps])
+            res = solver.solve(c=C, q=Q, l=L, u=U)
+            statuses = [int(s) for s in np.asarray(res.status)]
+            xs = list(np.asarray(res.x))
+            objs = list(np.asarray(res.obj))
+            ok = list(np.asarray(res.converged))
+        ys = np.asarray(res.y)
+        diags = [diagnose_infeasibility(lp0, ys[i] if ys.ndim > 1 else ys)
+                 if s == STATUS_PRIMAL_INFEASIBLE else
+                 "iteration limit reached before convergence"
+                 for i, s in enumerate(statuses)]
+        return xs, objs, ok, diags
 
     def _scatter_to_ders(self, solution: Dict[str, np.ndarray]) -> None:
         for der in self.ders:

@@ -129,3 +129,60 @@ class TestPDHGvsHiGHS:
         lp = b.build()
         res = CompiledLPSolver(lp, PDHGOptions(max_iters=2000)).solve()
         assert not bool(res.converged)
+
+
+class TestSparseEllPath:
+    """The ELL gather-matvec backend must match the dense backend exactly
+    (same algorithm, different matvec) and unlock large structures."""
+
+    def test_ell_matches_dense(self):
+        lp = battery_like_lp(T=96)
+        dense = CompiledLPSolver(lp, PDHGOptions()).solve()
+        ell = CompiledLPSolver(
+            lp, PDHGOptions(dense_bytes_limit=0)).solve()
+        from dervet_tpu.ops.pdhg import DenseOp, EllOp
+        assert isinstance(CompiledLPSolver(lp).op, DenseOp)
+        assert isinstance(CompiledLPSolver(lp, PDHGOptions(dense_bytes_limit=0)).op, EllOp)
+        assert bool(ell.converged)
+        ref = solve_lp_cpu(lp)
+        assert abs(float(ell.obj) - ref.obj) / max(1.0, abs(ref.obj)) < 1e-3
+        assert abs(float(ell.obj) - float(dense.obj)) / max(1.0, abs(ref.obj)) < 1e-3
+
+    def test_ell_batched(self):
+        lp = battery_like_lp(T=48)
+        rng = np.random.default_rng(3)
+        B = 4
+        prices = rng.uniform(5, 100, (B, 48)) / 1000
+        c_b = np.zeros((B, lp.n))
+        for i in range(B):
+            c_b[i, lp.var_refs["ch"].sl] = prices[i]
+            c_b[i, lp.var_refs["dis"].sl] = -prices[i]
+        res = CompiledLPSolver(lp, PDHGOptions(dense_bytes_limit=0)).solve(c=c_b)
+        for i in range(B):
+            ref = solve_lp_cpu(lp, c=c_b[i])
+            assert bool(res.converged[i])
+            assert abs(float(res.obj[i]) - ref.obj) / max(1.0, abs(ref.obj)) < 2e-3
+
+
+class TestInfeasibilityCertificate:
+    def test_early_exit_with_status(self):
+        from dervet_tpu.ops.pdhg import (STATUS_PRIMAL_INFEASIBLE,
+                                         diagnose_infeasibility)
+        b = LPBuilder()
+        v = b.var("x", 4, 0, 1)
+        b.add_rows("impossible_demand", [(v, np.ones((1, 4)))], "ge", 100.0)
+        b.add_cost(v, np.ones(4))
+        lp = b.build()
+        res = CompiledLPSolver(lp, PDHGOptions(max_iters=100_000)).solve()
+        assert not bool(res.converged)
+        assert int(res.status) == STATUS_PRIMAL_INFEASIBLE
+        # certificate fires long before the iteration limit burns out
+        assert int(res.iters) < 20_000
+        msg = diagnose_infeasibility(lp, res.y)
+        assert "impossible_demand" in msg
+
+    def test_feasible_not_flagged(self):
+        from dervet_tpu.ops.pdhg import STATUS_CONVERGED
+        lp = battery_like_lp(T=48)
+        res = CompiledLPSolver(lp).solve()
+        assert int(res.status) == STATUS_CONVERGED
