@@ -74,6 +74,18 @@ class DER:
         """State-of-energy block for aggregate energy requirements."""
         return None
 
+    def market_headroom(self, b: LPBuilder, direction: str
+                        ) -> Tuple[List[Tuple[VarRef, float]], float]:
+        """Available capacity for market services in kW as an affine
+        expression ``const + sum(coef * var)``.
+
+        ``direction`` 'up' = extra injection capability (raise discharge /
+        cut charge); 'down' = extra absorption.  Default: cannot
+        participate (reference: base DER zero-valued up/down schedules,
+        SURVEY.md §2.8 ``get_charge_up/down_schedule``).
+        """
+        return [], 0.0
+
     # full-horizon report series for the POI totals (post-solve)
     def load_series(self) -> Optional[np.ndarray]:
         """Effective load (kW) this DER contributes, incl. fixed loads."""

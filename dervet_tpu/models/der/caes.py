@@ -35,4 +35,5 @@ class CAES(EnergyStorage):
         fuel_per_kwh = self.heat_rate_high / 1e6 * price
         if fuel_per_kwh:
             b.add_cost(b[self.vname("dis")],
-                       fuel_per_kwh * ctx.dt * ctx.annuity_scalar)
+                       fuel_per_kwh * ctx.dt * ctx.annuity_scalar,
+                       label=f"{self.name} fuel_cost")

@@ -116,10 +116,12 @@ class EnergyStorage(DER):
 
         # operating costs
         if self.variable_om:
-            b.add_cost(dis, self.variable_om * dt * ctx.annuity_scalar)
+            b.add_cost(dis, self.variable_om * dt * ctx.annuity_scalar,
+                       label=f"{self.name} var_om")
         if self.fixed_om_per_kw:
             b.add_const_cost(self.fixed_om_per_kw * self.discharge_capacity()
-                             * ctx.annuity_scalar * (T * dt) / 8760.0)
+                             * ctx.annuity_scalar * (T * dt) / 8760.0,
+                             label=f"{self.name} fixed_om")
 
     def _daily_cycle_rows(self, b: LPBuilder, ctx: WindowContext, dis: VarRef):
         """sum_day(dis)*dt <= daily_cycle_limit * usable energy, per day."""
@@ -150,6 +152,15 @@ class EnergyStorage(DER):
 
     def soe_term(self, b: LPBuilder) -> Optional[VarRef]:
         return b[self.vname("ene")]
+
+    def market_headroom(self, b: LPBuilder, direction: str):
+        """Up: raise discharge to rated + cut charge to zero; down: raise
+        charge to rated + cut discharge (reference: storagevet EnergyStorage
+        get_discharge/charge_up/down_schedule surface)."""
+        ch, dis = b[self.vname("ch")], b[self.vname("dis")]
+        if direction == "up":
+            return [(dis, -1.0), (ch, 1.0)], self.discharge_capacity()
+        return [(ch, -1.0), (dis, 1.0)], self.charge_capacity()
 
     def load_series(self):
         if self.hp and self.variables_df is not None:

@@ -126,9 +126,11 @@ class ElectricVehicle2(DER):
         # lost-load cost on shed baseline energy: cost*(base-ch)*dt; the
         # constant part goes to c0 for faithful objective reporting
         if self.lost_load_cost:
-            b.add_cost(ch, -self.lost_load_cost * ctx.dt * ctx.annuity_scalar)
+            b.add_cost(ch, -self.lost_load_cost * ctx.dt * ctx.annuity_scalar,
+                       label=f"{self.name} lost_load")
             b.add_const_cost(float(np.sum(base)) * self.lost_load_cost
-                             * ctx.dt * ctx.annuity_scalar)
+                             * ctx.dt * ctx.annuity_scalar,
+                             label=f"{self.name} lost_load")
 
     def power_terms(self, b: LPBuilder) -> List[Tuple[VarRef, float]]:
         return [(b[self.vname("ch")], -1.0)]

@@ -58,13 +58,28 @@ class RotatingGenerator(DER):
         elec = b.var(self.vname("elec"), ctx.T, lb=0.0, ub=self.max_power_out)
         cost = (self.variable_om + self.fuel_cost_per_kwh(ctx)) * ctx.dt
         if cost:
-            b.add_cost(elec, cost * ctx.annuity_scalar)
+            b.add_cost(elec, cost * ctx.annuity_scalar,
+                       label=f"{self.name} fuel_and_om")
         if self.fixed_om_per_kw:
             b.add_const_cost(self.fixed_om_per_kw * self.max_power_out
-                             * ctx.annuity_scalar * (ctx.T * ctx.dt) / 8760.0)
+                             * ctx.annuity_scalar * (ctx.T * ctx.dt) / 8760.0,
+                             label=f"{self.name} fixed_om")
 
     def power_terms(self, b: LPBuilder) -> List[Tuple[VarRef, float]]:
         return [(b[self.vname("elec")], +1.0)]
+
+    market_participation = True
+
+    def market_headroom(self, b: LPBuilder, direction: str):
+        """Up: raise output to nameplate; down: cut output to zero (LP
+        relaxation of min_power; reference: RotatingGeneratorSizing.py
+        schedules).  DieselGenset overrides participation off."""
+        if not self.market_participation:
+            return [], 0.0
+        elec = b[self.vname("elec")]
+        if direction == "up":
+            return [(elec, -1.0)], self.max_power_out
+        return [(elec, 1.0)], 0.0
 
     def generation_series(self):
         v = self.variables_df

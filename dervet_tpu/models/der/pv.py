@@ -60,10 +60,12 @@ class PV(DER):
             b.var(self.vname("gen"), ctx.T, lb=gen_max, ub=gen_max)
         if self.ppa and self.ppa_cost:
             b.add_cost(b[self.vname("gen")],
-                       self.ppa_cost * ctx.dt * ctx.annuity_scalar)
+                       self.ppa_cost * ctx.dt * ctx.annuity_scalar,
+                       label=f"{self.name} ppa_cost")
         if self.fixed_om_per_kw:
             b.add_const_cost(self.fixed_om_per_kw * self.rated_capacity
-                             * ctx.annuity_scalar * (ctx.T * ctx.dt) / 8760.0)
+                             * ctx.annuity_scalar * (ctx.T * ctx.dt) / 8760.0,
+                             label=f"{self.name} fixed_om")
 
     def power_terms(self, b: LPBuilder) -> List[Tuple[VarRef, float]]:
         return [(b[self.vname("gen")], +1.0)]

@@ -65,3 +65,8 @@ class ValueStream:
         """Per-year $ rows (positive = benefit), column named after the
         stream; index pd.Period years."""
         return None
+
+    def drill_down_dfs(self, results: pd.DataFrame, dt: float
+                       ) -> Dict[str, pd.DataFrame]:
+        """Extra output frames (reference: drill-down CSVs, §2.7)."""
+        return {}

@@ -37,11 +37,12 @@ class DAEnergyTimeShift(ValueStream):
         scale = ctx.dt * ctx.annuity_scalar
         for der in ders:
             for ref, sign in der.power_terms(b):
-                b.add_cost(ref, -sign * price * scale)
+                b.add_cost(ref, -sign * price * scale, label="DA ETS")
         # constant loads priced exactly once, via the POI-computed total
         # (site load + DER fixed loads; see WindowContext.fixed_load)
         if ctx.fixed_load is not None:
-            b.add_const_cost(float(np.sum(price * ctx.fixed_load)) * scale)
+            b.add_const_cost(float(np.sum(price * ctx.fixed_load)) * scale,
+                             label="DA ETS")
 
     # ---------- results -------------------------------------------------
     def timeseries_report(self, index) -> pd.DataFrame:

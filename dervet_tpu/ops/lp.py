@@ -58,6 +58,15 @@ class LP:
     # several add_rows calls, and eq/ge rows are emitted in separate regions
     row_groups: Dict[str, List[Tuple[int, int]]]
     c0: float = 0.0          # constant objective offset (reporting only)
+    # label -> (cost vector over x, constant) for per-component objective
+    # reporting (reference: objective_values CSV columns, e.g. 'retailETS')
+    cost_groups: Dict[str, Tuple[np.ndarray, float]] = dataclasses.field(
+        default_factory=dict)
+
+    def objective_breakdown(self, x: np.ndarray) -> Dict[str, float]:
+        """Per-label objective contributions for a solution vector."""
+        return {label: float(vec @ x + const)
+                for label, (vec, const) in self.cost_groups.items()}
 
     @property
     def n(self) -> int:
@@ -92,8 +101,9 @@ class LPBuilder:
         self._by_name: Dict[str, VarRef] = {}
         self._lb: Dict[str, np.ndarray] = {}
         self._ub: Dict[str, np.ndarray] = {}
-        self._cost: List[Tuple[VarRef, np.ndarray]] = []
+        self._cost: List[Tuple[VarRef, np.ndarray, Optional[str]]] = []
         self._c0 = 0.0
+        self._c0_by_label: Dict[str, float] = {}
         # rows split by sense; each entry: (group_name, k, terms, rhs)
         self._eq_rows: List[Tuple[str, int, list, np.ndarray]] = []
         self._ge_rows: List[Tuple[str, int, list, np.ndarray]] = []
@@ -128,11 +138,14 @@ class LPBuilder:
                 np.asarray(ub, np.float64), (ref.size,)).copy()
 
     # ---------------- objective ----------------
-    def add_cost(self, ref: VarRef, vec) -> None:
-        self._cost.append((ref, np.broadcast_to(np.asarray(vec, np.float64), (ref.size,)).copy()))
+    def add_cost(self, ref: VarRef, vec, label: Optional[str] = None) -> None:
+        self._cost.append((ref, np.broadcast_to(
+            np.asarray(vec, np.float64), (ref.size,)).copy(), label))
 
-    def add_const_cost(self, val: float) -> None:
+    def add_const_cost(self, val: float, label: Optional[str] = None) -> None:
         self._c0 += float(val)
+        if label:
+            self._c0_by_label[label] = self._c0_by_label.get(label, 0.0) + float(val)
 
     # ---------------- constraints ----------------
     def add_rows(self, name: str, terms, sense: str, rhs) -> None:
@@ -191,8 +204,18 @@ class LPBuilder:
     def build(self) -> LP:
         n = self._n
         c = np.zeros(n)
-        for ref, vec in self._cost:
+        cost_groups: Dict[str, Tuple[np.ndarray, float]] = {}
+        for ref, vec, label in self._cost:
             c[ref.sl] += vec
+            if label:
+                if label not in cost_groups:
+                    cost_groups[label] = (np.zeros(n), 0.0)
+                cost_groups[label][0][ref.sl] += vec
+        for label, const in self._c0_by_label.items():
+            if label not in cost_groups:
+                cost_groups[label] = (np.zeros(n), 0.0)
+            vec, _ = cost_groups[label]
+            cost_groups[label] = (vec, const)
         l = (np.concatenate([self._lb[v.name] for v in self._vars])
              if self._vars else np.zeros(0))
         u = (np.concatenate([self._ub[v.name] for v in self._vars])
@@ -222,4 +245,5 @@ class LPBuilder:
         ).tocsr()
         q = np.concatenate(q_parts) if q_parts else np.zeros(0)
         return LP(c=c, K=K, q=q, n_eq=n_eq, l=l, u=u,
-                  var_refs=dict(self._by_name), row_groups=groups, c0=self._c0)
+                  var_refs=dict(self._by_name), row_groups=groups, c0=self._c0,
+                  cost_groups=cost_groups)

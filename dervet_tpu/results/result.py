@@ -85,6 +85,8 @@ class CaseResult:
         self.monthly_data = s.service_agg.monthly_report()
         if s.objective_values:
             self.objective_values = pd.DataFrame(s.objective_values).T
+        self.drill_down_dict.update(
+            s.service_agg.drill_down_dfs(self.time_series_data, s.dt))
 
     def calculate_cba(self) -> None:
         from ..financial.cba import CostBenefitAnalysis

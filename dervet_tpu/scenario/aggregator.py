@@ -65,3 +65,12 @@ class ServiceAggregator:
         if not frames:
             return pd.DataFrame()
         return pd.concat(frames, axis=1)
+
+    def drill_down_dfs(self, results: pd.DataFrame, dt: float
+                       ) -> Dict[str, pd.DataFrame]:
+        out: Dict[str, pd.DataFrame] = {}
+        for vs in self.value_streams.values():
+            fn = getattr(vs, "drill_down_dfs", None)
+            if fn is not None:
+                out.update(fn(results, dt))
+        return out

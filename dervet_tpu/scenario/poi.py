@@ -82,6 +82,36 @@ class POI:
 
         self._grid_charge_rows(b, ctx)
         self._requirement_rows(b, ctx, requirements)
+        self._market_rows(b, ctx)
+
+    def _market_rows(self, b: LPBuilder, ctx: WindowContext) -> None:
+        """Joint market-service rows: all services share DER headroom, and
+        storage reserves ``duration`` hours of SOE per awarded kW
+        (reference: co-optimized up/down schedules + qualifying energy,
+        SURVEY.md §2.8 ValueStreams / EnergyStorage schedules)."""
+        bids = ctx.market_bids
+        if not bids:
+            return
+        for direction, bid_list in bids.items():
+            terms = [(ref, 1.0) for ref, _ in bid_list]
+            const = 0.0
+            for d in self.active_ders:
+                der_terms, c = d.market_headroom(b, direction)
+                terms.extend((r, -coef) for r, coef in der_terms)
+                const += c
+            b.add_rows(f"market_headroom_{direction}", terms, "le", const)
+        ess = [d for d in self.active_ders
+               if d.technology_type == "Energy Storage System"]
+        if ess:
+            soe_terms = [(d.soe_term(b), 1.0) for d in ess]
+            e_min = sum(d.operational_min_energy() for d in ess)
+            e_max = sum(d.operational_max_energy() for d in ess)
+            up = [(ref, -dur) for ref, dur in bids.get("up", []) if dur]
+            if up:
+                b.add_rows("market_soe_up", soe_terms + up, "ge", e_min)
+            down = [(ref, dur) for ref, dur in bids.get("down", []) if dur]
+            if down:
+                b.add_rows("market_soe_down", soe_terms + down, "le", e_max)
 
     def _grid_charge_rows(self, b: LPBuilder, ctx: WindowContext) -> None:
         """PV grid_charge=0: storage may only charge from PV output —
@@ -128,6 +158,17 @@ class POI:
                         want = -1.0 if kind == "charge" else 1.0
                         if sign == want:
                             terms.append((ref, 1.0))
+            elif kind in ("poi import", "poi export"):
+                # net export = sum(sign*var) - fixed load; import = -export.
+                # 'poi export'/'max': net export <= arr; 'poi import'/'max':
+                # import <= arr i.e. net export >= -arr (senses pre-mapped
+                # by the requirement's min/max + kind)
+                load = ctx.fixed_load if ctx.fixed_load is not None else 0.0
+                flip = -1.0 if kind == "poi import" else 1.0
+                terms = [(ref, np.full(ctx.T, flip * sign))
+                         for d in self.active_ders
+                         for ref, sign in d.power_terms(b)]
+                arr = arr + flip * np.asarray(load)
             else:
                 continue
             if not terms:

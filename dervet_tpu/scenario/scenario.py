@@ -132,6 +132,7 @@ class MicrogridScenario:
     def build_window_lp(self, ctx: WindowContext, annuity_scalar: float = 1.0,
                         requirements=None) -> LP:
         ctx.annuity_scalar = annuity_scalar
+        ctx.market_bids = {}
         b = LPBuilder()
         self.poi.grab_active_ders(ctx.year)
         ctx.fixed_load = self.poi.site_load(ctx)
@@ -193,8 +194,9 @@ class MicrogridScenario:
                        f"did not solve: {diag}")
                 TellUser.error(msg)
                 raise SolverError(msg)
-            self.objective_values[ctx.label] = {
-                "Total Objective": float(obj) + lp.c0}
+            breakdown = lp.objective_breakdown(x)
+            breakdown["Total Objective"] = float(obj) + lp.c0
+            self.objective_values[ctx.label] = breakdown
             pos = np.searchsorted(self.index, ctx.index[0])
             for name, ref in lp.var_refs.items():
                 if name not in solution:
@@ -211,8 +213,9 @@ class MicrogridScenario:
                 ok.append(res.status == 0)
                 diags.append(getattr(res, "message", "") or "solver failure")
             return xs, objs, ok, diags
-        from ..ops.pdhg import (STATUS_PRIMAL_INFEASIBLE, CompiledLPSolver,
-                                PDHGOptions, diagnose_infeasibility)
+        from ..ops.pdhg import (STATUS_INACCURATE, STATUS_PRIMAL_INFEASIBLE,
+                                CompiledLPSolver, PDHGOptions,
+                                diagnose_infeasibility)
         solver = CompiledLPSolver(lp0, solver_opts or PDHGOptions())
         if len(lps) == 1:
             res = solver.solve()
@@ -230,11 +233,22 @@ class MicrogridScenario:
             xs = list(np.asarray(res.x))
             objs = list(np.asarray(res.obj))
             ok = list(np.asarray(res.converged))
-        ys = np.asarray(res.y)
-        diags = [diagnose_infeasibility(lp0, ys[i] if ys.ndim > 1 else ys)
-                 if s == STATUS_PRIMAL_INFEASIBLE else
-                 "iteration limit reached before convergence"
-                 for i, s in enumerate(statuses)]
+        # accept near-converged iteration-limit exits with a warning — the
+        # reference accepts CVXPY 'optimal_inaccurate' the same way
+        for i, s in enumerate(statuses):
+            if s == STATUS_INACCURATE:
+                ok[i] = True
+                TellUser.warning(
+                    "window solved to reduced accuracy (KKT within 10x "
+                    "tolerance at the iteration limit)")
+        if STATUS_PRIMAL_INFEASIBLE in statuses:
+            ys = np.asarray(res.y)
+            diags = [diagnose_infeasibility(lp0, ys[i] if ys.ndim > 1 else ys)
+                     if s == STATUS_PRIMAL_INFEASIBLE else
+                     "iteration limit reached before convergence"
+                     for i, s in enumerate(statuses)]
+        else:
+            diags = ["iteration limit reached before convergence"] * len(statuses)
         return xs, objs, ok, diags
 
     def _scatter_to_ders(self, solution: Dict[str, np.ndarray]) -> None:
@@ -245,6 +259,10 @@ class MicrogridScenario:
                       if name.startswith(prefix)}
             if values:
                 der.store_dispatch(self.index, values)
+        for vs in self.streams.values():
+            store = getattr(vs, "store_dispatch", None)
+            if store is not None:
+                store(self.index, solution)
 
     # ------------------------------------------------------------------
     def timeseries_results(self) -> pd.DataFrame:

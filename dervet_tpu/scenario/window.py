@@ -89,6 +89,12 @@ class WindowContext:
     # mutable per-window state handed between windows (e.g. battery SOE
     # carry, degraded energy capacity) keyed by component unique id
     carry: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # market-service capacity bids registered by value streams this window:
+    # direction ('up'/'down') -> list of (bid VarRef, duration hours).  The
+    # POI posts the JOINT headroom/SOE-reservation rows after all streams
+    # build, so concurrent services share the same DER headroom (reference:
+    # co-optimized service schedules, SURVEY.md §2.8 ValueStreams)
+    market_bids: Dict[str, List] = dataclasses.field(default_factory=dict)
 
     @property
     def T(self) -> int:

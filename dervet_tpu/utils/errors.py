@@ -22,6 +22,10 @@ class SolverError(Exception):
     """Dispatch optimization failed (non-convergence / infeasibility)."""
 
 
+class TariffError(Exception):
+    """Customer tariff missing or malformed."""
+
+
 class TellUser:
     """Static logger facade, mirrors the reference's TellUser usage."""
 

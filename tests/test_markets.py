@@ -1,0 +1,103 @@
+"""Market value streams FR/SR/NSR/LF: joint headroom + SOE reservation.
+
+Spec: storagevet market-stream surface (SURVEY.md §2.8) — capacity bids
+priced from the reference's price columns, all concurrent services share DER
+headroom, storage reserves duration-hours of energy per awarded kW.
+Reference input 001-DA_FR_SR_NSR_battery_month_ts_constraints.csv runs
+end-to-end (the reference's own test only asserts completion,
+test_3battery.py).
+"""
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dervet_tpu.api import DERVET
+
+REF = Path("/root/reference")
+CASE_001 = REF / ("test/model_params/"
+                  "001-DA_FR_SR_NSR_battery_month_ts_constraints.csv")
+
+
+@pytest.fixture(scope="module")
+def solved():
+    d = DERVET(CASE_001, base_path=REF)
+    return d.solve(backend="cpu")
+
+
+def test_market_case_runs(solved):
+    inst = solved.instances[0]
+    ts = inst.time_series_data
+    for col in ["FR Awarded Up (kW)", "FR Awarded Down (kW)",
+                "SR Awarded Up (kW)", "NSR Awarded Up (kW)"]:
+        assert col in ts.columns, col
+    assert (ts["FR Awarded Up (kW)"] >= -1e-6).all()
+
+
+def test_headroom_respected(solved):
+    """Sum of up awards can never exceed battery discharge headroom +
+    charge-cut headroom."""
+    inst = solved.instances[0]
+    ts = inst.time_series_data
+    s = inst.scenario
+    bat = next(d for d in s.ders if d.tag == "Battery")
+    dis_cap = bat.discharge_capacity()
+    ch = ts[bat.col("Charge (kW)")].to_numpy()
+    dis = ts[bat.col("Discharge (kW)")].to_numpy()
+    up = (ts["FR Awarded Up (kW)"] + ts["SR Awarded Up (kW)"]
+          + ts["NSR Awarded Up (kW)"]).to_numpy()
+    headroom = (dis_cap - dis) + ch
+    assert (up <= headroom + 1e-4).all()
+    down = ts["FR Awarded Down (kW)"].to_numpy()
+    ch_cap = bat.charge_capacity()
+    assert (down <= (ch_cap - ch) + dis + 1e-4).all()
+
+
+def test_soe_reservation(solved):
+    """With duration d, SOE must stay >= e_min + d*up_awards."""
+    inst = solved.instances[0]
+    s = inst.scenario
+    ts = inst.time_series_data
+    bat = next(d for d in s.ders if d.tag == "Battery")
+    durations = {tag: float(vs.duration) for tag, vs in s.streams.items()
+                 if hasattr(vs, "duration")}
+    up_reserved = np.zeros(len(ts))
+    for tag, dur in durations.items():
+        col = f"{tag} Awarded Up (kW)"
+        if dur and col in ts.columns:
+            up_reserved += dur * ts[col].to_numpy()
+    if up_reserved.any():
+        ene = ts[bat.col("State of Energy (kWh)")].to_numpy()
+        assert (ene >= bat.operational_min_energy() + up_reserved - 1e-3).all()
+
+
+def test_market_revenue_in_proforma(solved):
+    inst = solved.instances[0]
+    pf = inst.proforma_df
+    market_cols = [c for c in pf.columns
+                   if c.startswith(("FR ", "SR ", "NSR "))]
+    assert market_cols, pf.columns.tolist()
+    # battery earns regulation revenue with these prices
+    assert sum(pf.loc[2017, c] for c in market_cols) > 0
+
+
+def test_ts_bid_bounds():
+    """With u/d_ts_constraints on, awards respect the reference's
+    FR Reg Up/Down Max columns (001 ships them at 200 kW)."""
+    import dervet_tpu.io.params as p
+    cases = p.Params.initialize(CASE_001, base_path=REF)
+    case = cases[0]
+    for key in ("u_ts_constraints", "d_ts_constraints"):
+        case.streams["FR"][key] = True
+    from dervet_tpu.scenario.scenario import MicrogridScenario
+    s = MicrogridScenario(case)
+    s.optimize_problem_loop(backend="cpu")
+    ts = s.timeseries_results()
+    from dervet_tpu.scenario.window import grab_column
+    raw = case.datasets.time_series.loc[ts.index]
+    for award_col, max_col in [("FR Awarded Up (kW)", "FR Reg Up Max (kW)"),
+                               ("FR Awarded Down (kW)", "FR Reg Down Max (kW)")]:
+        cap = grab_column(raw, max_col)
+        assert cap is not None
+        assert (ts[award_col].to_numpy() <= cap + 1e-4).all(), award_col
