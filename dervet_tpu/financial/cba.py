@@ -122,17 +122,17 @@ class CostBenefitAnalysis:
 
     # ------------------------------------------------------------------
     def calculate(self, ders, value_streams: Dict, results: pd.DataFrame,
-                  opt_years: List[int]) -> None:
+                  opt_years: List[int], poi=None) -> None:
         self.proforma = self.proforma_report(ders, value_streams, results,
-                                             opt_years)
+                                             opt_years, poi)
         self.npv = self.npv_report(self.proforma)
         self.payback = self.payback_report(self.proforma)
         self.cost_benefit = self.cost_benefit_report(self.proforma)
 
     # ------------------------------------------------------------------
     def proforma_report(self, ders, value_streams: Dict,
-                        results: pd.DataFrame, opt_years: List[int]
-                        ) -> pd.DataFrame:
+                        results: pd.DataFrame, opt_years: List[int],
+                        poi=None) -> pd.DataFrame:
         years = list(range(self.start_year, self.end_year + 1))
         index = [CAPEX_ROW] + years
         proforma = pd.DataFrame(index=index)
@@ -143,7 +143,7 @@ class CostBenefitAnalysis:
                 proforma[name] = series
 
         for vs in value_streams.values():
-            df = vs.proforma_report(opt_years, None, results)
+            df = vs.proforma_report(opt_years, poi, results)
             if df is None:
                 continue
             for name in df.columns:

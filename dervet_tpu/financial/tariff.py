@@ -164,8 +164,7 @@ class TariffEngine:
                                  "Original Energy Charge ($)": oe,
                                  "Demand Charge ($)": np.nan,
                                  "Original Demand Charge ($)": np.nan})
-            for pid, val, _ in self.demand_masks(sub_index):
-                mask = self.period_mask(pid, sub_index)
+            for pid, val, mask in self.demand_masks(sub_index):
                 if not mask.any():
                     continue
                 applicable.append(pid)

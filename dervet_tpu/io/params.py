@@ -206,6 +206,7 @@ class Datasets:
     yearly: Optional[pd.DataFrame] = None
     tariff: Optional[pd.DataFrame] = None
     cycle_life: Optional[pd.DataFrame] = None
+    load_shed: Optional[pd.DataFrame] = None    # Reliability load-shed curve
 
 
 def load_time_series(path: Path, dt_hours: float) -> pd.DataFrame:
@@ -379,6 +380,10 @@ class Params:
                     keys.get("cycle_life_filename"):
                 datasets.cycle_life = pd.read_csv(
                     normalize_path(keys["cycle_life_filename"], base))
+        rel = streams.get("Reliability", {})
+        if rel.get("load_shed_percentage") and rel.get("load_shed_perc_filename"):
+            datasets.load_shed = pd.read_csv(
+                normalize_path(rel["load_shed_perc_filename"], base))
         return CaseParams(case_id=case_id, scenario=scenario, finance=finance,
                           results=results, ders=ders, streams=streams,
                           datasets=datasets, overrides=dict(overrides))

@@ -47,9 +47,18 @@ class PV(DER):
         from ...scenario.window import grab_column
         if grab_column(datasets.time_series, GEN_COL, self.id) is None:
             raise TimeseriesDataError(f"PV: missing column {GEN_COL!r}")
+        self.datasets = datasets
 
     def max_generation(self, ctx: WindowContext) -> np.ndarray:
         profile = ctx.col(GEN_COL, self.id)
+        return profile * self.rated_capacity
+
+    def maximum_generation_series(self, index: pd.DatetimeIndex) -> np.ndarray:
+        """Full-horizon nameplate generation (reference: PVSystem
+        ``maximum_generation()``, used by the reliability walk)."""
+        from ...scenario.window import grab_column
+        profile = grab_column(self.datasets.time_series.loc[index],
+                              GEN_COL, self.id)
         return profile * self.rated_capacity
 
     def build(self, b: LPBuilder, ctx: WindowContext) -> None:

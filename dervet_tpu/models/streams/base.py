@@ -23,7 +23,9 @@ class SystemRequirement:
     Requirement(kind, sense, source_name, array))."""
 
     def __init__(self, kind: str, sense: str, source: str, series: pd.Series):
-        assert kind in ("energy", "charge", "discharge", "poi import", "poi export")
+        # import limits are expressed as 'poi export' minima (net export =
+        # -import), so a single sign convention reaches the POI
+        assert kind in ("energy", "charge", "discharge", "poi export")
         assert sense in ("min", "max")
         self.kind = kind
         self.sense = sense

@@ -208,9 +208,6 @@ class SpinningReserve(MarketService):
     def _use_ts_bounds(self, direction: str) -> bool:
         return bool(self.keys.get("ts_constraints", False))
 
-    def _bound_cols(self, stem: str):
-        return f"{stem} Max (kW)", f"{stem} Min (kW)"
-
 
 class NonspinningReserve(MarketService):
     """NSR: up-only reserve priced by 'NSR Price ($/kW)'."""

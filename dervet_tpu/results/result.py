@@ -87,6 +87,10 @@ class CaseResult:
             self.objective_values = pd.DataFrame(s.objective_values).T
         self.drill_down_dict.update(
             s.service_agg.drill_down_dfs(self.time_series_data, s.dt))
+        rel = s.streams.get("Reliability")
+        if rel is not None:
+            self.drill_down_dict.update(
+                rel.drill_down_reports(s.ders, self.time_series_data))
 
     def calculate_cba(self) -> None:
         from ..financial.cba import CostBenefitAnalysis
@@ -97,7 +101,8 @@ class CaseResult:
         except Exception as e:  # financial inputs optional in early slices
             TellUser.warning(f"CBA skipped: {e}")
             return
-        cba.calculate(s.ders, s.streams, self.time_series_data, s.opt_years)
+        cba.calculate(s.ders, s.streams, self.time_series_data, s.opt_years,
+                      poi=s.poi)
         self.proforma_df = cba.proforma
         self.npv_df = cba.npv
         self.payback_df = cba.payback

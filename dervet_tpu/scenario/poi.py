@@ -147,7 +147,10 @@ class POI:
         for (kind, sense), arr in merged.items():
             if not np.isfinite(arr).any():
                 continue
-            arr = np.where(np.isfinite(arr), arr, 0.0 if sense == "min" else 1e30)
+            # non-finite gaps become non-binding: a 'min' gap is 0 for
+            # nonneg quantities but -inf for signed net export
+            lo_fill = -1e30 if kind == "poi export" else 0.0
+            arr = np.where(np.isfinite(arr), arr, lo_fill if sense == "min" else 1e30)
             if kind == "energy":
                 refs = [d.soe_term(b) for d in self.active_ders]
                 terms = [(r, 1.0) for r in refs if r is not None]
@@ -158,17 +161,14 @@ class POI:
                         want = -1.0 if kind == "charge" else 1.0
                         if sign == want:
                             terms.append((ref, 1.0))
-            elif kind in ("poi import", "poi export"):
-                # net export = sum(sign*var) - fixed load; import = -export.
-                # 'poi export'/'max': net export <= arr; 'poi import'/'max':
-                # import <= arr i.e. net export >= -arr (senses pre-mapped
-                # by the requirement's min/max + kind)
+            elif kind == "poi export":
+                # net export = sum(sign*var) - fixed load:
+                # min arr -> sum(sign*var) >= arr + load (ge), max -> le
                 load = ctx.fixed_load if ctx.fixed_load is not None else 0.0
-                flip = -1.0 if kind == "poi import" else 1.0
-                terms = [(ref, np.full(ctx.T, flip * sign))
+                terms = [(ref, np.full(ctx.T, sign))
                          for d in self.active_ders
                          for ref, sign in d.power_terms(b)]
-                arr = arr + flip * np.asarray(load)
+                arr = arr + np.asarray(load)
             else:
                 continue
             if not terms:

@@ -147,6 +147,9 @@ class MicrogridScenario:
                               solver_opts=None) -> None:
         """Group windows by length, batch-solve each group, scatter results."""
         t0 = time.time()
+        deferral = self.streams.get("Deferral")
+        if deferral is not None:
+            deferral.deferral_analysis(self.ders, self.opt_years, self.end_year)
         requirements = self.service_agg.identify_system_requirements(
             self.ders, self.opt_years, self.index)
         annuity_scalar = 1.0

@@ -1,0 +1,162 @@
+"""Reliability stream: vectorized outage walk, min-SOE schedule, LCPC.
+
+Spec: dervet/MicrogridValueStreams/Reliability.py — the greedy SOE walk
+(:489-570), min-SOE-iterative schedule (:685-732), LCPC accounting
+(:876-966) and contribution waterfall (:806-874).  The vectorized
+scan/vmap walk is cross-validated here against a direct scalar
+re-simulation of the reference semantics.
+"""
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dervet_tpu.io.params import Params
+from dervet_tpu.models.streams.reliability import (
+    Reliability, _simulate_all_outages, rolling_forward_sum)
+from dervet_tpu.scenario.scenario import MicrogridScenario
+
+REF = Path("/root/reference")
+MP = REF / "test/test_storagevet_features/model_params"
+
+
+def scalar_walk(rc, dl, ec, init_soe, ch_max, dis_max, e_min, e_max, rte,
+                dt, L, start):
+    """Direct reading of the reference simulate_outage semantics
+    (Reliability.py:489-570) for one outage start."""
+    soe = init_soe
+    profile = []
+    for j in range(L):
+        i = start + j
+        if i >= len(rc):
+            break
+        if rc[i] <= 0:
+            if e_max >= soe:
+                charge = min((e_max - soe) / (rte * dt), -dl[i], ch_max)
+                charge = max(charge, 0.0)
+                soe = soe + charge * rte * dt
+        else:
+            if round(ec[i] * dt - soe, 2) <= 0:
+                discharge = min((soe - e_min) / dt, dl[i], dis_max)
+                if round(dl[i] - discharge, 2) > 0:
+                    break
+                soe = soe - discharge * dt
+            else:
+                break
+        profile.append(soe)
+    return profile
+
+
+def test_walk_matches_scalar_reference():
+    rng = np.random.default_rng(7)
+    T, L = 200, 12
+    crit = rng.uniform(0, 100, T)
+    gen = np.full(T, 30.0)
+    pv = rng.uniform(0, 60, T)
+    rc = np.around(crit - gen - pv, 5)
+    dl = np.around(crit - gen - pv, 5)
+    ec = rc.copy()
+    params = dict(ch_max=40.0, dis_max=50.0, e_min=10.0, e_max=200.0,
+                  rte=0.85, dt=1.0)
+    init = np.full(T, 120.0)
+    cov, prof = _simulate_all_outages(
+        rc, dl, ec, init, params["ch_max"], params["dis_max"],
+        params["e_min"], params["e_max"], params["rte"], params["dt"], L)
+    cov = np.asarray(cov)
+    prof = np.asarray(prof)
+    for start in range(0, T, 17):
+        expect = scalar_walk(rc, dl, ec, 120.0, L=L, start=start, **params)
+        assert cov[start] == len(expect), start
+        got = prof[start, :len(expect)]
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-4)
+
+
+def test_rolling_forward_sum():
+    arr = np.array([1.0, 2.0, 3.0, 4.0])
+    np.testing.assert_allclose(rolling_forward_sum(arr, 2), [3, 5, 7, 4])
+    np.testing.assert_allclose(rolling_forward_sum(arr, 10), [10, 9, 7, 4])
+
+
+def _case_with_reliability(**rel_keys):
+    cases = Params.initialize(MP / "000-DA_battery_month.csv", base_path=REF)
+    case = cases[0]
+    keys = {"target": 2, "post_facto_initial_soc": 100,
+            "post_facto_only": 0, "max_outage_duration": 8, "n-2": 0,
+            "load_shed_percentage": 0}
+    keys.update(rel_keys)
+    case.streams["Reliability"] = keys
+    return case
+
+
+@pytest.fixture(scope="module")
+def solved_rel():
+    case = _case_with_reliability()
+    s = MicrogridScenario(case)
+    s.optimize_problem_loop(backend="cpu")
+    return s
+
+
+def test_min_soe_requirement_enforced(solved_rel):
+    s = solved_rel
+    rel = s.streams["Reliability"]
+    assert rel.min_soe_df is not None
+    ts = s.timeseries_results()
+    soe = ts["Aggregated State of Energy (kWh)"].to_numpy()
+    need = rel.min_soe_df["soe"].to_numpy()
+    assert (soe >= need - 1e-3).all()
+    assert "Total Critical Load (kWh)" in ts.columns
+    assert "Critical Load (kW)" in ts.columns
+
+
+def test_lcpc_shape_and_monotonicity(solved_rel):
+    s = solved_rel
+    rel = s.streams["Reliability"]
+    ts = s.timeseries_results()
+    lcpc = rel.load_coverage_probability(s.ders, ts)
+    assert len(lcpc) == 8
+    p = lcpc["Load Coverage Probability (%)"].to_numpy()
+    assert (p >= 0).all() and (p <= 1).all()
+    assert (np.diff(p) <= 1e-12).all()   # longer outages never more coverable
+
+
+def test_lcpc_with_huge_battery_is_certain():
+    case = _case_with_reliability()
+    for tag, der_id, keys in case.ders:
+        if tag == "Battery":
+            keys["ene_max_rated"] = 1e7
+            keys["dis_max_rated"] = 1e5
+            keys["ch_max_rated"] = 1e5
+    s = MicrogridScenario(case)
+    rel = s.streams["Reliability"]
+    rel._prepare(s.index)
+    results = pd.DataFrame(index=s.index)
+    lcpc = rel.load_coverage_probability(s.ders, results)
+    assert (lcpc["Load Coverage Probability (%)"] == 1.0).all()
+
+
+def test_contribution_waterfall(solved_rel):
+    s = solved_rel
+    rel = s.streams["Reliability"]
+    ts = s.timeseries_results()
+    contrib = rel.contribution_summary(s.ders, ts)
+    assert "Storage Outage Contribution (kWh)" in contrib.columns
+    assert (contrib["Storage Outage Contribution (kWh)"] >= -1e-9).all()
+
+
+def test_post_facto_only_skips_requirements():
+    case = _case_with_reliability(post_facto_only=1)
+    s = MicrogridScenario(case)
+    reqs = s.service_agg.identify_system_requirements(
+        s.ders, s.opt_years, s.index)
+    assert [r for r in reqs if r.source == "Reliability"] == []
+
+
+def test_drill_down_reports(solved_rel):
+    s = solved_rel
+    rel = s.streams["Reliability"]
+    ts = s.timeseries_results()
+    dd = rel.drill_down_reports(s.ders, ts)
+    assert "load_coverage_prob" in dd
+    assert "lcp_outage_soe_profiles" in dd
+    assert "outage_energy_contributions" in dd
