@@ -111,11 +111,19 @@ class CostBenefitAnalysis:
 
     def annuity_scalar(self, opt_years: List[int]) -> float:
         """Scalar converting one optimized year's cost to lifetime present
-        value (reference CBA.py:190-213) — used in sizing objectives."""
-        n_years = self.end_year - self.start_year + 1
+        value (exact reference formula, CBA.py:190-213): n = end - start
+        project years, $1 at the base optimized year escalated by inflation
+        in both directions, then npv with the base-year cashflow at k=1."""
+        n_years = self.end_year - self.start_year
+        if n_years <= 0:
+            return 1.0
         dollars = np.ones(n_years)
-        for k in range(1, n_years):
-            dollars[k] = dollars[k - 1] * (1 + self.inflation_rate)
+        base = min(int(y) for y in opt_years) - self.start_year
+        base = min(max(base, 0), n_years - 1)
+        for k in range(base, n_years - 1):
+            dollars[k + 1] = dollars[k] * (1 + self.inflation_rate)
+        for k in range(base, 0, -1):
+            dollars[k - 1] = dollars[k] / (1 + self.inflation_rate)
         pv = sum(d / (1 + self.npv_discount_rate) ** (k + 1)
                  for k, d in enumerate(dollars))
         return float(pv)
@@ -154,13 +162,15 @@ class CostBenefitAnalysis:
                         col[yr] = val
                 proforma[name] = col
 
-        proforma = self._fill_forward(proforma, opt_years)
+        stream_cols = [c for c in proforma.columns
+                       if not any(c.startswith(d.unique_tech_id) for d in ders)]
+        proforma = self._fill_forward(proforma, opt_years, stream_cols)
         if self.ecc_mode:
             TellUser.warning("ecc_mode proforma substitution not yet "
                              "implemented; using direct capital costs")
         taxes = self.calculate_taxes(proforma, ders)
-        if taxes is not None:
-            proforma["Overall Tax Burden"] = taxes
+        proforma["Overall Tax Burden"] = (
+            taxes if taxes is not None else 0.0)
         proforma["Yearly Net Value"] = proforma.sum(axis=1)
         return proforma
 
@@ -222,10 +232,13 @@ class CostBenefitAnalysis:
                 return 0.0
         return float(raw or 0)
 
-    def _fill_forward(self, proforma: pd.DataFrame,
-                      opt_years: List[int]) -> pd.DataFrame:
-        """Copy each non-optimized year's value from the nearest previous
-        optimized year (escalation hooks per-stream later)."""
+    def _fill_forward(self, proforma: pd.DataFrame, opt_years: List[int],
+                      stream_cols: List[str]) -> pd.DataFrame:
+        """Fill each non-optimized year from the nearest previous optimized
+        year.  Value-stream columns escalate at the inflation rate; DER
+        operating-cost columns stay flat (behavior matched to the frozen
+        Usecase1 proforma: Avoided charges grow 2.2%/yr while Fixed O&M
+        holds at the optimized-year value)."""
         years = [y for y in proforma.index if y != CAPEX_ROW]
         opt_set = sorted(set(opt_years))
         for y in years:
@@ -242,7 +255,9 @@ class CostBenefitAnalysis:
                 if "Salvage" in colname or "Decommissioning" in colname:
                     continue
                 if col[y] == 0.0 and col[src] != 0.0:
-                    proforma.loc[y, colname] = col[src]
+                    esc = (1 + self.inflation_rate) ** (y - src) \
+                        if colname in stream_cols else 1.0
+                    proforma.loc[y, colname] = col[src] * esc
         return proforma
 
     # ------------------------------------------------------------------

@@ -64,6 +64,17 @@ class EnergyStorage(DER):
                              "binary formulation; ignored in the LP relaxation")
         # fraction of rated energy usable (degradation hooks update this)
         self.soh = 1.0
+        # sizing: a zero rating is a size decision variable (reference:
+        # ESSSizing.py:82-138 swaps zeros for CVXPY integer Variables with
+        # user min/max bounds; here a continuous scalar LP variable)
+        self.sizing_ene = self.ene_max_rated == 0
+        self.sizing_ch = self.ch_max_rated == 0
+        self.sizing_dis = self.dis_max_rated == 0
+        self.user_bounds = {
+            "ene": (g("user_ene_rated_min"), g("user_ene_rated_max")),
+            "ch": (g("user_ch_rated_min"), g("user_ch_rated_max")),
+            "dis": (g("user_dis_rated_min"), g("user_dis_rated_max")),
+        }
 
     # ---------------- capacity accessors (sizing overrides later) ------
     def energy_capacity(self) -> float:
@@ -86,8 +97,19 @@ class EnergyStorage(DER):
         return self.soc_target * self.soh * self.energy_capacity()
 
     # ---------------- LP assembly --------------------------------------
+    def being_sized(self) -> bool:
+        return self.sizing_ene or self.sizing_ch or self.sizing_dis
+
+    def _size_var(self, b: LPBuilder, which: str):
+        lo, hi = self.user_bounds[which]
+        return b.var(self.vname(f"size_{which}"), 1, lb=max(lo, 0.0),
+                     ub=hi if hi > 0 else np.inf)
+
     def build(self, b: LPBuilder, ctx: WindowContext) -> None:
         T, dt = ctx.T, ctx.dt
+        if self.being_sized():
+            self._build_sizing(b, ctx)
+            return
         e_max = self.operational_max_energy()
         e_min = self.operational_min_energy()
         e0 = ctx.carry.get(self.vname("soe0"), self.ene_target)
@@ -123,8 +145,135 @@ class EnergyStorage(DER):
                              * ctx.annuity_scalar * (T * dt) / 8760.0,
                              label=f"{self.name} fixed_om")
 
-    def _daily_cycle_rows(self, b: LPBuilder, ctx: WindowContext, dis: VarRef):
-        """sum_day(dis)*dt <= daily_cycle_limit * usable energy, per day."""
+    def _build_sizing(self, b: LPBuilder, ctx: WindowContext) -> None:
+        """Sizing formulation: zero ratings become scalar size variables;
+        capacity bounds/SOE targets become rows against them (reference:
+        ESSSizing.py:82-138 effective-SOE expressions + bound constraints;
+        continuous relaxation of the integer sizes per SURVEY §7)."""
+        T, dt = ctx.T, ctx.dt
+        one = np.ones((T, 1))
+        ene = b.var(self.vname("ene"), T, lb=0.0)
+        ch = b.var(self.vname("ch"), T, lb=0.0,
+                   ub=np.inf if self.sizing_ch else self.charge_capacity())
+        dis = b.var(self.vname("dis"), T, lb=0.0,
+                    ub=np.inf if self.sizing_dis else self.discharge_capacity())
+
+        if self.sizing_ene:
+            size_e = self._size_var(b, "ene")
+            b.add_rows(self.vname("ene_ub"),
+                       [(ene, 1.0), (size_e, -self.ulsoc * self.soh * one)],
+                       "le", 0.0)
+            if self.llsoc > 0:
+                b.add_rows(self.vname("ene_lb"),
+                           [(ene, 1.0), (size_e, -self.llsoc * self.soh * one)],
+                           "ge", 0.0)
+            target_term = [(size_e, np.full((1, 1), -self.soc_target * self.soh))]
+            b.add_cost(size_e, self.ccost_kwh, label=f"{self.name}capex")
+        else:
+            b.set_bounds(ene, lb=self.operational_min_energy(),
+                         ub=self.operational_max_energy())
+            target_term = []
+        if self.sizing_ch and self.sizing_dis:
+            # both ratings zero: size ONE power cap shared by charge and
+            # discharge (reference: ESSSizing.py:97-106 sets
+            # dis_max_rated = ch_max_rated)
+            size_p = self._size_var(b, "dis")
+            b.add_rows(self.vname("ch_ub"), [(ch, 1.0), (size_p, -one)],
+                       "le", 0.0)
+            b.add_rows(self.vname("dis_ub"), [(dis, 1.0), (size_p, -one)],
+                       "le", 0.0)
+            b.add_cost(size_p, self.ccost_kw, label=f"{self.name}capex")
+            # NOTE: no fixed-O&M on the sized rating — the reference
+            # evaluates fixedOM * dis_max_rated before ESSSizing swaps the
+            # zero rating for a variable, so sized DERs carry zero fixed
+            # O&M in the sizing objective (verified against the Usecase1
+            # size golden: including it undershoots the size by 7%)
+        elif self.sizing_ch:
+            size_c = self._size_var(b, "ch")
+            b.add_rows(self.vname("ch_ub"), [(ch, 1.0), (size_c, -one)],
+                       "le", 0.0)
+        elif self.sizing_dis:
+            size_d = self._size_var(b, "dis")
+            b.add_rows(self.vname("dis_ub"), [(dis, 1.0), (size_d, -one)],
+                       "le", 0.0)
+            b.add_cost(size_d, self.ccost_kw, label=f"{self.name}capex")
+        if self.ccost:
+            b.add_const_cost(self.ccost, label=f"{self.name}capex")
+        if self.duration_max and self.sizing_ene and self.sizing_dis:
+            b.add_rows(self.vname("duration_max"),
+                       [(b[self.vname("size_ene")], np.ones((1, 1))),
+                        (b[self.vname("size_dis")],
+                         np.full((1, 1), -self.duration_max))], "le", 0.0)
+
+        # SOE evolution with window-entry/exit pinned to soc_target * size
+        diag = sp.diags([np.full(T, 1.0 + self.sdr), np.full(T - 1, -1.0)],
+                        offsets=[0, -1], format="csr")
+        first = sp.csr_matrix((np.ones(1), (np.zeros(1, int), np.zeros(1, int))),
+                              shape=(T, 1))
+        soe_terms = [(ene, diag), (ch, -self.rte * dt), (dis, dt)]
+        if target_term:
+            ref, coef = target_term[0]
+            soe_terms.append((ref, first * float(coef[0, 0])))
+            b.add_rows(self.vname("soe"), soe_terms, "eq", np.zeros(T))
+            end_row = np.zeros((1, T))
+            end_row[0, T - 1] = 1.0
+            b.add_rows(self.vname("soe_end"),
+                       [(ene, sp.csr_matrix(end_row)), (ref, coef)], "eq", 0.0)
+        else:
+            rhs = np.zeros(T)
+            rhs[0] = self.ene_target
+            b.add_rows(self.vname("soe"), soe_terms, "eq", rhs)
+            end_row = np.zeros(T)
+            end_row[T - 1] = 1.0
+            b.add_rows(self.vname("soe_end"),
+                       [(ene, sp.csr_matrix(end_row))], "eq",
+                       np.array([self.ene_target]))
+
+        if self.daily_cycle_limit > 0:
+            if self.sizing_ene:
+                # sum_day(dis)*dt <= limit * usable * size_e — linear in the
+                # size variable, carried into the sizing LP
+                mat = self._daily_sum_matrix(ctx)
+                usable = self.daily_cycle_limit * (self.ulsoc - self.llsoc) \
+                    * self.soh
+                b.add_rows(self.vname("daily_cycle"),
+                           [(dis, mat),
+                            (b[self.vname("size_ene")],
+                             np.full((mat.shape[0], 1), -usable))],
+                           "le", 0.0)
+            else:
+                self._daily_cycle_rows(b, ctx, dis)
+
+        if self.variable_om:
+            b.add_cost(dis, self.variable_om * dt * ctx.annuity_scalar,
+                       label=f"{self.name} var_om")
+        if self.fixed_om_per_kw and not self.sizing_dis:
+            b.add_const_cost(self.fixed_om_per_kw * self.discharge_capacity()
+                             * ctx.annuity_scalar * (T * dt) / 8760.0,
+                             label=f"{self.name} fixed_om")
+
+    def set_size(self, sizes: Dict[str, float]) -> None:
+        """Freeze solved size variables into ratings (reference:
+        ESSSizing.set_size, applied after the first window —
+        MicrogridScenario.py:361-363)."""
+        if "size_ene" in sizes:
+            self.ene_max_rated = float(sizes["size_ene"])
+            self.sizing_ene = False
+        if "size_ch" in sizes:
+            self.ch_max_rated = float(sizes["size_ch"])
+            self.sizing_ch = False
+        if "size_dis" in sizes:
+            self.dis_max_rated = float(sizes["size_dis"])
+            if self.sizing_ch:      # shared power cap (both were zero)
+                self.ch_max_rated = self.dis_max_rated
+                self.sizing_ch = False
+            self.sizing_dis = False
+        TellUser.info(f"{self.name} sized: {self.ene_max_rated:.1f} kWh, "
+                      f"ch {self.ch_max_rated:.1f} kW / "
+                      f"dis {self.dis_max_rated:.1f} kW")
+
+    def _daily_sum_matrix(self, ctx: WindowContext) -> sp.csr_matrix:
+        """(n_days, T) matrix summing dis*dt per calendar day."""
         days = ctx.index.normalize()
         uniq = days.unique()
         rows_i, cols_i = [], []
@@ -132,14 +281,18 @@ class EnergyStorage(DER):
             idx = np.nonzero(np.asarray(days == d))[0]
             rows_i.append(np.full(len(idx), i))
             cols_i.append(idx)
-        mat = sp.coo_matrix(
+        return sp.coo_matrix(
             (np.full(sum(len(c) for c in cols_i), ctx.dt),
              (np.concatenate(rows_i), np.concatenate(cols_i))),
             shape=(len(uniq), ctx.T)).tocsr()
+
+    def _daily_cycle_rows(self, b: LPBuilder, ctx: WindowContext, dis: VarRef):
+        """sum_day(dis)*dt <= daily_cycle_limit * usable energy, per day."""
+        mat = self._daily_sum_matrix(ctx)
         cap = self.daily_cycle_limit * (self.operational_max_energy()
                                         - self.operational_min_energy())
         b.add_rows(self.vname("daily_cycle"), [(dis, mat)], "le",
-                   np.full(len(uniq), cap))
+                   np.full(mat.shape[0], cap))
 
     # ---------------- POI interface -------------------------------------
     def power_terms(self, b: LPBuilder) -> List[Tuple[VarRef, float]]:
@@ -156,11 +309,25 @@ class EnergyStorage(DER):
     def market_headroom(self, b: LPBuilder, direction: str):
         """Up: raise discharge to rated + cut charge to zero; down: raise
         charge to rated + cut discharge (reference: storagevet EnergyStorage
-        get_discharge/charge_up/down_schedule surface)."""
+        get_discharge/charge_up/down_schedule surface).  While a rating is
+        being sized, its size variable supplies the nameplate term."""
         ch, dis = b[self.vname("ch")], b[self.vname("dis")]
         if direction == "up":
-            return [(dis, -1.0), (ch, 1.0)], self.discharge_capacity()
-        return [(ch, -1.0), (dis, 1.0)], self.charge_capacity()
+            terms, const = [(dis, -1.0), (ch, 1.0)], self.discharge_capacity()
+            if self.sizing_dis and b.has(self.vname("size_dis")):
+                terms.append((b[self.vname("size_dis")], 1.0))
+                const = 0.0
+            return terms, const
+        terms, const = [(ch, -1.0), (dis, 1.0)], self.charge_capacity()
+        if self.sizing_ch:
+            # shared power sizing registers a single 'size_dis' variable
+            # (reference ties ch==dis when both are zero)
+            for cand in ("size_ch", "size_dis"):
+                if b.has(self.vname(cand)):
+                    terms.append((b[self.vname(cand)], 1.0))
+                    const = 0.0
+                    break
+        return terms, const
 
     def load_series(self):
         if self.hp and self.variables_df is not None:

@@ -54,9 +54,41 @@ class RotatingGenerator(DER):
     def fuel_cost_per_kwh(self, ctx: WindowContext) -> float:
         return 0.0
 
+    _size_frozen = False
+
+    def being_sized(self) -> bool:
+        return self.rated_power == 0 and not self._size_frozen
+
+    def set_size(self, sizes) -> None:
+        if "size" in sizes:
+            self.rated_power = float(sizes["size"])
+            self._size_frozen = True
+
     def build(self, b: LPBuilder, ctx: WindowContext) -> None:
-        elec = b.var(self.vname("elec"), ctx.T, lb=0.0, ub=self.max_power_out)
         cost = (self.variable_om + self.fuel_cost_per_kwh(ctx)) * ctx.dt
+        if self.being_sized():
+            # rated power as a scalar LP variable, n units fixed (reference:
+            # RotatingGeneratorSizing.py:60-66,110-136, LP relaxation)
+            g = lambda k, d=0.0: float(self.keys.get(k, d) or 0.0)
+            lo, hi = g("min_rated_capacity"), g("max_rated_capacity")
+            size = b.var(self.vname("size"), 1, lb=max(lo, 0.0),
+                         ub=hi if hi > 0 else np.inf)
+            elec = b.var(self.vname("elec"), ctx.T, lb=0.0)
+            b.add_rows(self.vname("elec_cap"),
+                       [(elec, 1.0),
+                        (size, -self.n_units * np.ones((ctx.T, 1)))],
+                       "le", 0.0)
+            b.add_cost(size, self.ccost_kw * self.n_units,
+                       label=f"{self.name}capex")
+            if self.ccost:
+                b.add_const_cost(self.ccost, label=f"{self.name}capex")
+            # no fixed-O&M on the sized rating (reference artifact — see
+            # the equivalent note in ess.py)
+            if cost:
+                b.add_cost(elec, cost * ctx.annuity_scalar,
+                           label=f"{self.name} fuel_and_om")
+            return
+        elec = b.var(self.vname("elec"), ctx.T, lb=0.0, ub=self.max_power_out)
         if cost:
             b.add_cost(elec, cost * ctx.annuity_scalar,
                        label=f"{self.name} fuel_and_om")

@@ -61,7 +61,34 @@ class PV(DER):
                               GEN_COL, self.id)
         return profile * self.rated_capacity
 
+    _size_frozen = False
+
+    def being_sized(self) -> bool:
+        return self.rated_capacity == 0 and not self._size_frozen
+
+    def set_size(self, sizes) -> None:
+        if "size" in sizes:
+            self.rated_capacity = float(sizes["size"])
+            self._size_frozen = True
+
     def build(self, b: LPBuilder, ctx: WindowContext) -> None:
+        if self.being_sized():
+            # rated capacity as a scalar LP variable: gen tied to
+            # profile * size (reference: IntermittentResourceSizing.py:70-91,
+            # continuous relaxation of the integer capacity)
+            g = lambda k, d=0.0: float(self.keys.get(k, d) or 0.0)
+            lo, hi = g("min_rated_capacity"), g("max_rated_capacity")
+            size = b.var(self.vname("size"), 1, lb=max(lo, 0.0),
+                         ub=hi if hi > 0 else np.inf)
+            gen = b.var(self.vname("gen"), ctx.T, lb=0.0)
+            profile = np.asarray(ctx.col(GEN_COL, self.id))[:, None]
+            sense = "le" if self.curtail else "eq"
+            b.add_rows(self.vname("gen_cap"),
+                       [(gen, 1.0), (size, -profile)], sense, 0.0)
+            b.add_cost(size, self.cost_per_kw, label=f"{self.name}capex")
+            # no fixed-O&M on the sized rating (reference artifact — see
+            # the equivalent note in ess.py)
+            return
         gen_max = np.minimum(self.max_generation(ctx), self.inv_max)
         if self.curtail:
             b.var(self.vname("gen"), ctx.T, lb=0.0, ub=gen_max)

@@ -456,12 +456,25 @@ class VoltVar(ValueStream):
         derate = np.sqrt(np.maximum(1.0 - reserve ** 2, 0.0))
         for d in ders:
             if d.technology_type == "Energy Storage System":
-                b.add_rows(f"voltvar_{d.vname('dis')}",
-                           [(b[d.vname("dis")], 1.0)], "le",
-                           d.discharge_capacity() * derate)
-                b.add_rows(f"voltvar_{d.vname('ch')}",
-                           [(b[d.vname("ch")], 1.0)], "le",
-                           d.charge_capacity() * derate)
+                # sized ratings derate against the size variable instead of
+                # the (zero) numeric rating
+                for q, cap, sizing in (
+                        ("dis", d.discharge_capacity(),
+                         getattr(d, "sizing_dis", False)),
+                        ("ch", d.charge_capacity(),
+                         getattr(d, "sizing_ch", False))):
+                    size_name = d.vname("size_dis" if sizing and
+                                        not b.has(d.vname(f"size_{q}"))
+                                        else f"size_{q}")
+                    if sizing and b.has(size_name):
+                        b.add_rows(f"voltvar_{d.vname(q)}",
+                                   [(b[d.vname(q)], 1.0),
+                                    (b[size_name], -derate[:, None])],
+                                   "le", 0.0)
+                    else:
+                        b.add_rows(f"voltvar_{d.vname(q)}",
+                                   [(b[d.vname(q)], 1.0)], "le",
+                                   cap * derate)
             elif d.tag == "PV" and b.has(d.vname("gen")):
                 # only curtailable PV can respond to a derate; fixed
                 # (lb==ub) generation would make the row infeasible

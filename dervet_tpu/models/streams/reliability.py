@@ -167,7 +167,11 @@ class Reliability(ValueStream):
                 pv_vari += gen * getattr(d, "nu", 1.0)
                 largest_gamma = max(largest_gamma, getattr(d, "gamma", 1.0))
             elif ttype == "Generator":
-                dg_max += getattr(d, "max_power_out", 0.0)
+                rating = getattr(d, "max_power_out", 0.0)
+                dg_max += rating
+                # n-2: hold the LARGEST single unit out of the walk
+                # (reference Reliability.py:328-330 dg_rating margin)
+                self.dg_rating = max(self.dg_rating, rating)
             elif ttype == "Energy Storage System":
                 props["rte list"].append(d.rte)
                 props["soe min"] += d.operational_min_energy()

@@ -90,10 +90,15 @@ class DenseOp(NamedTuple):
 
 
 class EllOp(NamedTuple):
-    data: jax.Array          # (m, k)  row-padded values
+    data: jax.Array          # (m, k)  row-padded values (dense cols removed)
     cols: jax.Array          # (m, k)  int32 column ids (pad -> 0, data 0)
-    data_t: jax.Array        # (n, kt) transpose table
+    data_t: jax.Array        # (n, kt) transpose table (dense cols removed)
     cols_t: jax.Array        # (n, kt)
+    # near-dense columns (epigraph/size variables touch nearly every row) are
+    # carried as an explicit (m, kd) dense block — padding them into the
+    # ELLPACK transpose would blow kt up to m and exhaust HBM
+    dense_idx: jax.Array     # (kd,) int32 column ids
+    dense_blk: jax.Array     # (m, kd)
 
 
 MatOp = Union[DenseOp, EllOp]
@@ -115,29 +120,51 @@ def _csr_to_ell(K) -> tuple[np.ndarray, np.ndarray]:
 
 
 def make_op(K_scaled, dense_bytes_limit: int = 32 * 1024 * 1024,
-            dtype=jnp.float32) -> MatOp:
+            dtype=jnp.float32, dense_col_factor: int = 16) -> MatOp:
     """Pick dense vs ELL for the (already Ruiz-scaled) constraint matrix."""
     m, n = K_scaled.shape
     if m * n * jnp.dtype(dtype).itemsize <= dense_bytes_limit:
         return DenseOp(Kh=jnp.asarray(K_scaled.todense(), dtype))
-    d, c = _csr_to_ell(K_scaled)
-    dt, ct = _csr_to_ell(K_scaled.T.tocsr())
+    csc = K_scaled.tocsc()
+    col_nnz = np.diff(csc.indptr)
+    mean_nnz = max(col_nnz.mean(), 1.0)
+    dense_cols = np.nonzero(col_nnz > dense_col_factor * mean_nnz)[0]
+    if len(dense_cols):
+        blk = np.asarray(csc[:, dense_cols].todense())
+        sparse_part = K_scaled.tolil(copy=True)
+        sparse_part[:, dense_cols] = 0.0
+        sparse_part = sparse_part.tocsr()
+        sparse_part.eliminate_zeros()
+    else:
+        blk = np.zeros((m, 0))
+        sparse_part = K_scaled
+    d, c = _csr_to_ell(sparse_part)
+    dt, ct = _csr_to_ell(sparse_part.T.tocsr())
     return EllOp(data=jnp.asarray(d, dtype), cols=jnp.asarray(c),
-                 data_t=jnp.asarray(dt, dtype), cols_t=jnp.asarray(ct))
+                 data_t=jnp.asarray(dt, dtype), cols_t=jnp.asarray(ct),
+                 dense_idx=jnp.asarray(dense_cols, jnp.int32),
+                 dense_blk=jnp.asarray(blk, dtype))
 
 
 def op_matvec(op: MatOp, x: jax.Array, prec) -> jax.Array:
     """K @ x (scaled space)."""
     if isinstance(op, DenseOp):
         return jnp.matmul(op.Kh, x, precision=prec)
-    return jnp.sum(op.data * x[op.cols], axis=-1)
+    out = jnp.sum(op.data * x[op.cols], axis=-1)
+    if op.dense_blk.shape[1]:
+        out = out + jnp.matmul(op.dense_blk, x[op.dense_idx], precision=prec)
+    return out
 
 
 def op_rmatvec(op: MatOp, y: jax.Array, prec) -> jax.Array:
     """K.T @ y (scaled space)."""
     if isinstance(op, DenseOp):
         return jnp.matmul(op.Kh.T, y, precision=prec)
-    return jnp.sum(op.data_t * y[op.cols_t], axis=-1)
+    out = jnp.sum(op.data_t * y[op.cols_t], axis=-1)
+    if op.dense_blk.shape[1]:
+        out = out.at[op.dense_idx].add(
+            jnp.matmul(op.dense_blk.T, y, precision=prec))
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -92,6 +92,17 @@ class POI:
         bids = ctx.market_bids
         if not bids:
             return
+
+        def expand(terms):
+            """Scalar coefs on size-1 blocks become (T, 1) columns so they
+            broadcast across the row block (size variables in sizing runs)."""
+            out = []
+            for ref, coef in terms:
+                if ref.size == 1 and np.isscalar(coef):
+                    coef = np.full((ctx.T, 1), float(coef))
+                out.append((ref, coef))
+            return out
+
         for direction, bid_list in bids.items():
             terms = [(ref, 1.0) for ref, _ in bid_list]
             const = 0.0
@@ -99,19 +110,31 @@ class POI:
                 der_terms, c = d.market_headroom(b, direction)
                 terms.extend((r, -coef) for r, coef in der_terms)
                 const += c
-            b.add_rows(f"market_headroom_{direction}", terms, "le", const)
+            b.add_rows(f"market_headroom_{direction}", expand(terms), "le",
+                       const)
         ess = [d for d in self.active_ders
                if d.technology_type == "Energy Storage System"]
         if ess:
             soe_terms = [(d.soe_term(b), 1.0) for d in ess]
-            e_min = sum(d.operational_min_energy() for d in ess)
-            e_max = sum(d.operational_max_energy() for d in ess)
+            e_min = e_max = 0.0
+            min_extra, max_extra = [], []
+            for d in ess:
+                if getattr(d, "sizing_ene", False) and \
+                        b.has(d.vname("size_ene")):
+                    ref = b[d.vname("size_ene")]
+                    min_extra.append((ref, -d.llsoc * d.soh))
+                    max_extra.append((ref, -d.ulsoc * d.soh))
+                else:
+                    e_min += d.operational_min_energy()
+                    e_max += d.operational_max_energy()
             up = [(ref, -dur) for ref, dur in bids.get("up", []) if dur]
             if up:
-                b.add_rows("market_soe_up", soe_terms + up, "ge", e_min)
+                b.add_rows("market_soe_up",
+                           expand(soe_terms + min_extra + up), "ge", e_min)
             down = [(ref, dur) for ref, dur in bids.get("down", []) if dur]
             if down:
-                b.add_rows("market_soe_down", soe_terms + down, "le", e_max)
+                b.add_rows("market_soe_down",
+                           expand(soe_terms + max_extra + down), "le", e_max)
 
     def _grid_charge_rows(self, b: LPBuilder, ctx: WindowContext) -> None:
         """PV grid_charge=0: storage may only charge from PV output —

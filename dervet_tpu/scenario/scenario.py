@@ -154,14 +154,36 @@ class MicrogridScenario:
             self.ders, self.opt_years, self.index)
         annuity_scalar = 1.0
         if self.poi.is_sizing_optimization:
-            annuity_scalar = self.solve_metadata.get("annuity_scalar", 1.0)
+            self.check_opt_sizing_conditions()
+            from ..financial.cba import CostBenefitAnalysis
+            cba = CostBenefitAnalysis(self.case.finance, self.start_year,
+                                      self.end_year, self.opt_years, self.dt)
+            annuity_scalar = cba.annuity_scalar(self.opt_years)
+            self.solve_metadata["annuity_scalar"] = annuity_scalar
         if not self.opt_engine:
             return
 
         # per-variable full-horizon arrays, filled window by window
         solution: Dict[str, np.ndarray] = {}
-        groups = group_by_length(self.windows)
+        windows = self.windows
         n_solves = 0
+        if self.poi.is_sizing_optimization:
+            # solve the first window with size variables, freeze the sizes,
+            # then batch the remaining windows at fixed size (reference:
+            # der.set_size() after window 1, MicrogridScenario.py:361-363)
+            self._solve_subgroup(
+                [(windows[0], self.build_window_lp(windows[0], annuity_scalar,
+                                                   requirements))],
+                backend, solver_opts, solution, freeze_sizes=True)
+            n_solves += 1
+            windows = windows[1:]
+            # capacity-dependent requirements (Reliability min-SOE, RA
+            # qualifying capacity) were computed against zero ratings;
+            # recompute them now that sizes are frozen so the remaining
+            # windows are constrained correctly
+            requirements = self.service_agg.identify_system_requirements(
+                self.ders, self.opt_years, self.index)
+        groups = group_by_length(windows)
         for T, ctxs in sorted(groups.items()):
             built = [(ctx, self.build_window_lp(ctx, annuity_scalar, requirements))
                      for ctx in ctxs]
@@ -186,7 +208,8 @@ class MicrogridScenario:
         })
 
     def _solve_subgroup(self, pairs, backend, solver_opts,
-                        solution: Dict[str, np.ndarray]) -> None:
+                        solution: Dict[str, np.ndarray],
+                        freeze_sizes: bool = False) -> None:
         ctxs = [p[0] for p in pairs]
         lps = [p[1] for p in pairs]
         xs, objs, ok, diags = self._solve_group(lps[0], lps, backend, solver_opts)
@@ -202,9 +225,59 @@ class MicrogridScenario:
             self.objective_values[ctx.label] = breakdown
             pos = np.searchsorted(self.index, ctx.index[0])
             for name, ref in lp.var_refs.items():
+                short = name.split("/", 1)[-1]
+                if short.startswith("size"):
+                    continue      # scalar size vars are frozen, not dispatch
                 if name not in solution:
                     solution[name] = np.zeros(len(self.index))
                 solution[name][pos:pos + ctx.T] = x[ref.sl]
+            if freeze_sizes:
+                for der in self.ders:
+                    prefix = f"{der.tag}-{der.id or '1'}/"
+                    sizes = {name[len(prefix):]: float(x[ref.sl][0])
+                             for name, ref in lp.var_refs.items()
+                             if name.startswith(prefix)
+                             and name[len(prefix):].startswith("size")}
+                    if sizes:
+                        der.set_size(sizes)
+
+    def check_opt_sizing_conditions(self) -> None:
+        """Sizing feasibility guards (reference MicrogridScenario.py:208-247):
+        year-long windows required, no binary + power sizing, no post-facto-
+        only reliability sizing, wholesale power sizing needs participation
+        limits."""
+        error = False
+        if str(self.n).strip().lower() != "year":
+            TellUser.error("sizing requires the optimization window n='year'")
+            error = True
+        if self.incl_binary:
+            TellUser.error("sizing with the binary formulation is nonlinear "
+                           "(reference forbids it, MicrogridPOI.py:132-147)")
+            error = True
+        if self.service_agg.post_facto_reliability_only():
+            TellUser.error("trying to size for reliability with post-facto-"
+                           "only calculations; turn off post_facto_only or "
+                           "stop sizing")
+            error = True
+        if self.service_agg.is_whole_sale_market():
+            power_sized = any(
+                getattr(d, "sizing_ch", False) or getattr(d, "sizing_dis", False)
+                or (d.technology_type == "Generator" and d.being_sized())
+                for d in self.ders)
+            ts = self.case.datasets.time_series
+            from .window import grab_column
+            has_limits = any(
+                grab_column(ts, col) is not None
+                for col in ("FR Reg Up Max (kW)", "SR Max (kW)",
+                            "NSR Max (kW)", "LF Reg Up Max (kW)"))
+            if power_sized and not has_limits:
+                TellUser.error("sizing power against unbounded wholesale "
+                               "market participation is unbounded; add "
+                               "market max participation constraints")
+                error = True
+        if error:
+            raise ParameterError(
+                "sizing pre-checks failed; see log for details")
 
     def _solve_group(self, lp0: LP, lps: List[LP], backend: str, solver_opts):
         if backend == "cpu":

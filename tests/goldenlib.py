@@ -1,0 +1,86 @@
+"""Golden-file comparators (re-implements /root/reference/test/TestingLib.py
+semantics: relative-error bound in percent, cell-by-cell comparison of
+proforma / size / LCPC frames against the frozen reference CSVs)."""
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+REF = Path("/root/reference")
+
+
+def assert_within_error_bound(expected, actual, bound_pct: float, msg=""):
+    """|actual - expected| / |expected| <= bound_pct %  (reference
+    TestingLib.py:56-60)."""
+    expected = float(expected)
+    actual = float(actual)
+    if expected == 0.0:
+        assert abs(actual) < 1e-6 or abs(actual) <= bound_pct, \
+            f"{msg} expected 0, got {actual}"
+        return
+    err = abs(actual - expected) / abs(expected) * 100.0
+    assert err <= bound_pct, \
+        f"{msg} expected {expected}, got {actual} ({err:.2f}% > {bound_pct}%)"
+
+
+def _ci_lookup(columns, name: str):
+    low = {str(c).strip().lower(): c for c in columns}
+    return low.get(str(name).strip().lower())
+
+
+def compare_proforma_results(inst, frozen_path, bound_pct: float):
+    """Cell-by-cell vs the frozen proforma (reference TestingLib.py:78-96).
+    Columns matched case-insensitively; expected all-zero columns may be
+    absent from the result."""
+    expected = pd.read_csv(frozen_path, index_col=0)
+    got = inst.proforma_df.copy()
+    got.index = [str(i) for i in got.index]
+    for col in expected.columns:
+        gcol = _ci_lookup(got.columns, col)
+        if gcol is None:
+            assert np.allclose(expected[col].to_numpy(dtype=float), 0.0), \
+                f"missing non-zero proforma column {col!r}"
+            continue
+        for idx in expected.index:
+            exp = expected.loc[idx, col]
+            if pd.isna(exp):
+                continue
+            assert str(idx) in got.index, f"missing proforma row {idx}"
+            assert_within_error_bound(
+                exp, got.loc[str(idx), gcol], bound_pct,
+                f"proforma[{idx}, {col}]:")
+
+
+def compare_size_results(inst, frozen_path, bound_pct: float):
+    """Size frame vs frozen CSV (reference TestingLib.py:119-135)."""
+    expected = pd.read_csv(frozen_path, index_col="DER")
+    got = inst.sizing_df
+    for der in expected.index:
+        gder = _ci_lookup(got.index, der)
+        if gder is None:
+            row = expected.loc[der]
+            assert not row.notna().any() or \
+                np.allclose(row.dropna().to_numpy(dtype=float), 0.0), \
+                f"missing sized DER {der!r}"
+            continue
+        for col in expected.columns:
+            exp = expected.loc[der, col]
+            if pd.isna(exp):
+                continue
+            gcol = _ci_lookup(got.columns, col)
+            if gcol is None or pd.isna(got.loc[gder, gcol]):
+                continue
+            assert_within_error_bound(exp, got.loc[gder, gcol], bound_pct,
+                                      f"size[{der}, {col}]:")
+
+
+def compare_lcpc_results(inst, frozen_path, bound_pct: float):
+    """LCPC curve vs frozen CSV (reference TestingLib.py:138-148)."""
+    test_df = inst.drill_down_dict.get("load_coverage_prob")
+    assert test_df is not None
+    actual = pd.read_csv(frozen_path)
+    got = test_df.reset_index()
+    for i in actual.index:
+        exp = actual.loc[i, "Load Coverage Probability (%)"]
+        val = got.loc[i, "Load Coverage Probability (%)"]
+        assert_within_error_bound(exp, val, bound_pct, f"lcpc[{i}]:")

