@@ -165,9 +165,9 @@ class CostBenefitAnalysis:
         stream_cols = [c for c in proforma.columns
                        if not any(c.startswith(d.unique_tech_id) for d in ders)]
         proforma = self._fill_forward(proforma, opt_years, stream_cols)
+        proforma = self._zero_out_dead_ders(proforma, ders)
         if self.ecc_mode:
-            TellUser.warning("ecc_mode proforma substitution not yet "
-                             "implemented; using direct capital costs")
+            proforma = self._ecc_substitution(proforma, ders)
         taxes = self.calculate_taxes(proforma, ders)
         proforma["Overall Tax Burden"] = (
             taxes if taxes is not None else 0.0)
@@ -195,19 +195,104 @@ class CostBenefitAnalysis:
                         col[yr] = val
                 cols[name] = col
 
-        # lifecycle: decommissioning + salvage at end of analysis
-        # (reference CBA.py:409-438 + DERExtension semantics)
+        # lifecycle: replacements at failure years (escalated at ter from
+        # the operation year), decommissioning at min(end, last op + 1),
+        # salvage at end of analysis (reference CBA.py:348-438 +
+        # DERExtension.py:162-265)
+        failure_years = der.set_failure_years(self.end_year, self.start_year)
+        if der.replaceable and failure_years:
+            rep = zero()
+            rcost = der.replacement_cost()
+            for fy in failure_years:
+                pay_year = fy + 1 - der.replacement_construction_time
+                if pay_year in rep.index and fy < self.end_year:
+                    esc = (1 + der.escalation_rate) ** \
+                        (pay_year - (der.operation_year or self.start_year))
+                    rep[pay_year] += -rcost * esc
+            cols[f"{uid} Replacement Costs"] = rep
+        base_yr = min(opt_years) if opt_years else self.start_year
         decomm = float(der.keys.get("decommissioning_cost", 0) or 0)
         dec = zero()
         if decomm:
-            dec[self.end_year] = -decomm
+            dec_year = min(self.end_year,
+                           getattr(der, "last_operation_year", self.end_year) + 1)
+            # escalate the nominal cost at inflation from the optimized year
+            # (reference CBA.py:419-435)
+            dec[dec_year] = -decomm * (1 + self.inflation_rate) ** \
+                (dec_year - base_yr)
         cols[f"{uid} Decommissioning Cost"] = dec
         salvage = self._salvage_value(der, capex)
         sal = zero()
         if salvage:
-            sal[self.end_year] = salvage
+            sal[self.end_year] = salvage * (1 + der.escalation_rate) ** \
+                (self.end_year - base_yr)
         cols[f"{uid} Salvage Value"] = sal
         return cols
+
+    def _zero_out_dead_ders(self, proforma: pd.DataFrame, ders
+                            ) -> pd.DataFrame:
+        """Zero every cost/benefit column of a non-replaceable DER past its
+        last operational year; once ALL DERs are dead, zero the whole
+        proforma (reference CBA.py:366-390)."""
+        last_years = []
+        for der in ders:
+            if der.replaceable or not der.expected_lifetime:
+                last_years.append(self.end_year)
+                continue
+            last = getattr(der, "last_operation_year", self.end_year)
+            last_years.append(last)
+            uid = der.unique_tech_id
+            dead = [y for y in proforma.index
+                    if y != CAPEX_ROW and y > last]
+            for col in proforma.columns:
+                if col.startswith(uid) and "Salvage" not in col \
+                        and "Decommissioning" not in col:
+                    proforma.loc[dead, col] = 0.0
+        if last_years:
+            no_more_der_yr = max(last_years)
+            dead_all = [y for y in proforma.index
+                        if y != CAPEX_ROW and y > no_more_der_yr]
+            if dead_all:
+                keep = [c for c in proforma.columns
+                        if "Salvage" in c or "Decommissioning" in c]
+                zero_cols = [c for c in proforma.columns if c not in keep]
+                proforma.loc[dead_all, zero_cols] = 0.0
+        return proforma
+
+    def _ecc_substitution(self, proforma: pd.DataFrame, ders
+                          ) -> pd.DataFrame:
+        """ECC mode: replace capex + replacement columns with annualized
+        economic carrying costs (reference CBA.py:323-338 +
+        DERExtension.economic_carrying_cost_report, :267-306)."""
+        self.ecc_breakdown = {}
+        for der in ders:
+            if not der.ecc_perc or not der.expected_lifetime:
+                continue
+            uid = der.unique_tech_id
+            capex_col = f"{uid} Capital Cost"
+            rep_col = f"{uid} Replacement Costs"
+            proforma[capex_col] = 0.0
+            if rep_col in proforma.columns:
+                proforma[rep_col] = 0.0
+            op = der.operation_year or self.start_year
+            last = min(op + der.expected_lifetime - 1, self.end_year)
+            cc = pd.Series(0.0, index=proforma.index, dtype=float)
+            capex = der.get_capex()
+            for y in range(op, last + 1):
+                infl = (1 + self.inflation_rate) ** \
+                    (y - (der.construction_year or op))
+                if y in cc.index:
+                    cc[y] = -capex * der.ecc_perc * infl
+            proforma[f"{uid} Carrying Cost"] = cc
+            self.ecc_breakdown[uid] = cc
+        return proforma
+
+    def equipment_lifetime_report(self, ders) -> pd.DataFrame:
+        """Beginning of Life / Operation Begins / End of Life per DER
+        (reference CBA.py:525-536; golden equipment_lifetimes CSV)."""
+        cols = {d.unique_tech_id: d.equipment_lifetime_row(self.end_year)
+                for d in ders}
+        return pd.DataFrame(cols)
 
     def _salvage_value(self, der, capex: float) -> float:
         """'sunk cost' -> 0; 'linear salvage value' -> capex * remaining

@@ -121,9 +121,91 @@ class DER:
         return {}
 
     # ---------- lifecycle (DERExtension surface) -----------------------
+    # (reference: dervet/MicrogridDER/DERExtension.py — construction /
+    # operation years, failure years, replacement, escalation, ECC, MACRS)
+    def _lifecycle_int(self, key: str, default: int = 0) -> int:
+        try:
+            return int(float(self.keys.get(key, default) or default))
+        except (TypeError, ValueError):
+            return default
+
+    @property
+    def construction_year(self) -> int:
+        return self._lifecycle_int("construction_year")
+
+    @property
+    def operation_year(self) -> int:
+        return self._lifecycle_int("operation_year")
+
+    @property
+    def expected_lifetime(self) -> int:
+        return self._lifecycle_int("expected_lifetime")
+
+    @property
+    def replaceable(self) -> bool:
+        return bool(self.keys.get("replaceable", False))
+
+    @property
+    def replacement_construction_time(self) -> int:
+        return max(self._lifecycle_int("replacement_construction_time", 1), 1)
+
+    @property
+    def escalation_rate(self) -> float:
+        return float(self.keys.get("ter", 0) or 0) / 100.0
+
+    @property
+    def ecc_perc(self) -> float:
+        return float(self.keys.get("ecc%", 0) or 0) / 100.0
+
+    def replacement_cost(self) -> float:
+        """Cost of replacing this DER (reference: rcost/rcost_kW/rcost_kWh
+        dot product, ESSSizing.py:438-444; subclasses refine)."""
+        return float(self.keys.get("rcost", 0) or 0)
+
+    def set_failure_years(self, end_year: int,
+                          start_year: Optional[int] = None) -> List[int]:
+        """Years this equipment fails, incl. periodic replacements
+        (reference: DERExtension.set_failure_years, :86-114).  A missing
+        operation_year means operation starts at the project start."""
+        lifetime = self.expected_lifetime
+        if not lifetime:
+            self.failure_years: List[int] = []
+            self.last_operation_year = end_year
+            return self.failure_years
+        op = self.operation_year or start_year or end_year
+        last = op + lifetime - 1
+        years = []
+        if last <= end_year:
+            years.append(last)
+        if self.replaceable:
+            nxt = last + lifetime
+            while nxt < end_year:
+                years.append(nxt)
+                nxt += lifetime
+            self.last_operation_year = end_year
+        else:
+            self.last_operation_year = last
+        self.failure_years = sorted(set(years))
+        return self.failure_years
+
+    def equipment_lifetime_row(self, end_year: int,
+                               start_year: Optional[int] = None) -> Dict[str, int]:
+        """Rows for the equipment_lifetimes report (golden columns:
+        Beginning of Life / Operation Begins / End of Life)."""
+        self.set_failure_years(end_year, start_year)
+        return {"Beginning of Life": self.construction_year or self.operation_year,
+                "Operation Begins": self.operation_year,
+                "End of Life": self.last_operation_year}
+
     def operational(self, year: int) -> bool:
-        op_year = int(self.keys.get("operation_year", 0) or 0)
-        return year >= op_year if op_year else True
+        op_year = self.operation_year
+        if op_year and year < op_year:
+            return False
+        last = getattr(self, "last_operation_year", None)
+        if last is not None and not self.replaceable and \
+                self.expected_lifetime and year > last:
+            return False
+        return True
 
     def being_sized(self) -> bool:
         return False

@@ -355,6 +355,13 @@ class EnergyStorage(DER):
         return (self.ccost + self.ccost_kw * self.discharge_capacity()
                 + self.ccost_kwh * self.energy_capacity())
 
+    def replacement_cost(self) -> float:
+        """rcost + rcost_kW*dis + rcost_kWh*ene (reference:
+        ESSSizing.py:438-444)."""
+        g = lambda k: float(self.keys.get(k, 0) or 0)
+        return (g("rcost") + g("rcost_kW") * self.discharge_capacity()
+                + g("rcost_kWh") * self.energy_capacity())
+
     def proforma_report(self, opt_years, apply_inflation_rate_func=None,
                         fill_forward_func=None):
         """Fixed + variable O&M rows per optimized year (reference:
@@ -400,9 +407,83 @@ class Battery(EnergyStorage):
         super().__init__("Battery", der_id, keys, scenario)
         self.incl_cycle_degrade = bool(keys.get("incl_cycle_degrade", False))
         self.cycle_life = cycle_life
+        g = lambda k, d=0.0: float(keys.get(k, d) or 0.0)
+        self.yearly_degrade = g("yearly_degrade") / 100.0
+        self.state_of_health = g("state_of_health") / 100.0
+        # replaceable comes from the base lifecycle property (keys)
+        self.degrade_perc = 0.0
+        self.years_system_degraded: set = set()
+        self.degradation_log: List[Dict] = []
+        self._damage_model = None
+        if self.incl_cycle_degrade:
+            if cycle_life is None:
+                raise ParameterError(
+                    f"{self.name}: incl_cycle_degrade requires a "
+                    "cycle_life_filename")
+            from .degradation import CycleDegradation
+            self._damage_model = CycleDegradation(cycle_life)
         if self.duration_max and self.dis_max_rated:
             if self.ene_max_rated > self.duration_max * self.dis_max_rated:
                 raise ParameterError(
                     f"{self.name}: energy rating {self.ene_max_rated} exceeds "
                     f"duration_max*discharge rating "
                     f"{self.duration_max * self.dis_max_rated}")
+
+    # ---------------- degradation lifecycle ----------------------------
+    # (reference: Battery.py:69-110 calc_degradation + replacement reset;
+    # the rainflow damage model itself lives in degradation.py)
+    def degraded_energy_capacity(self) -> float:
+        return (1.0 - self.degrade_perc) * self.energy_capacity()
+
+    def calc_degradation(self, window_index: pd.DatetimeIndex,
+                         soe: np.ndarray) -> None:
+        """Update SOH after one solved window from its SOE profile."""
+        if not self.incl_cycle_degrade:
+            return
+        cap = self.energy_capacity()
+        if cap <= 0:
+            return
+        cycle = self._damage_model.damage(np.asarray(soe) / cap)
+        hours = len(window_index) * self.dt
+        calendar = self.yearly_degrade * hours / 8760.0
+        self.degrade_perc += cycle + calendar
+        year = int(window_index[0].year)
+        replaced = False
+        if self.degraded_energy_capacity() <= cap * self.state_of_health:
+            self.years_system_degraded.add(year)
+            if self.replaceable:
+                self.degrade_perc = 0.0
+                replaced = True
+                TellUser.info(f"{self.name}: replaced in {year} (SOH hit "
+                              f"{self.state_of_health:.0%})")
+            else:
+                TellUser.warning(f"{self.name}: reached end of life in "
+                                 f"{year} and is not replaceable")
+        self.soh = 1.0 - self.degrade_perc
+        self.degradation_log.append({
+            "Window Start": window_index[0], "Cycle Degradation": cycle,
+            "Calendar Degradation": calendar,
+            "State of Health (%)": self.soh * 100.0, "Replaced": replaced})
+
+    def degradation_report(self) -> Optional[pd.DataFrame]:
+        if not self.degradation_log:
+            return None
+        return pd.DataFrame(self.degradation_log).set_index("Window Start")
+
+    def estimated_lifetime_years(self) -> Optional[float]:
+        """Years until SOH hits the replacement threshold at the observed
+        average degradation rate (reference:
+        set_end_of_life_based_on_degradation_cycle, Battery.py:112-179)."""
+        if not self.degradation_log:
+            return None
+        df = pd.DataFrame(self.degradation_log)
+        total = df["Cycle Degradation"].sum() + df["Calendar Degradation"].sum()
+        spans = df["Window Start"]
+        span_years = 1.0
+        if len(spans) >= 2:
+            span_years = max((spans.iloc[-1] - spans.iloc[0]).days / 365.25,
+                             1.0 / 12.0)
+        rate = total / span_years
+        if rate <= 0:
+            return None
+        return (1.0 - self.state_of_health) / rate

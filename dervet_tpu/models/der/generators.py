@@ -105,12 +105,17 @@ class RotatingGenerator(DER):
     def market_headroom(self, b: LPBuilder, direction: str):
         """Up: raise output to nameplate; down: cut output to zero (LP
         relaxation of min_power; reference: RotatingGeneratorSizing.py
-        schedules).  DieselGenset overrides participation off."""
+        schedules).  DieselGenset overrides participation off.  While the
+        rating is being sized, its size variable supplies the nameplate."""
         if not self.market_participation:
             return [], 0.0
         elec = b[self.vname("elec")]
         if direction == "up":
-            return [(elec, -1.0)], self.max_power_out
+            terms, const = [(elec, -1.0)], self.max_power_out
+            if self.being_sized() and b.has(self.vname("size")):
+                terms.append((b[self.vname("size")], float(self.n_units)))
+                const = 0.0
+            return terms, const
         return [(elec, 1.0)], 0.0
 
     def generation_series(self):
@@ -125,6 +130,10 @@ class RotatingGenerator(DER):
 
     def get_capex(self) -> float:
         return self.ccost + self.ccost_kw * self.max_power_out
+
+    def replacement_cost(self) -> float:
+        g = lambda k: float(self.keys.get(k, 0) or 0)
+        return g("rcost") + g("rcost_kW") * self.max_power_out
 
     def proforma_report(self, opt_years, apply_inflation_rate_func=None,
                         fill_forward_func=None):

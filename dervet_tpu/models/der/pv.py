@@ -119,6 +119,10 @@ class PV(DER):
     def get_capex(self) -> float:
         return self.cost_per_kw * self.rated_capacity
 
+    def replacement_cost(self) -> float:
+        g = lambda k: float(self.keys.get(k, 0) or 0)
+        return g("rcost") + g("rcost_kW") * self.rated_capacity
+
     def sizing_summary(self) -> Dict:
         return {
             "DER": self.name,

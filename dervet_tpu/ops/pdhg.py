@@ -131,9 +131,10 @@ def make_op(K_scaled, dense_bytes_limit: int = 32 * 1024 * 1024,
     dense_cols = np.nonzero(col_nnz > dense_col_factor * mean_nnz)[0]
     if len(dense_cols):
         blk = np.asarray(csc[:, dense_cols].todense())
-        sparse_part = K_scaled.tolil(copy=True)
-        sparse_part[:, dense_cols] = 0.0
-        sparse_part = sparse_part.tocsr()
+        # zero the dense columns in one vectorized CSR pass (tolil would
+        # duplicate a matrix already too large for the dense path)
+        sparse_part = K_scaled.tocsr(copy=True)
+        sparse_part.data[np.isin(sparse_part.indices, dense_cols)] = 0.0
         sparse_part.eliminate_zeros()
     else:
         blk = np.zeros((m, 0))
@@ -198,6 +199,11 @@ class PDHGOptions:
     inaccurate_factor: float = 10.0
     # switch K to ELLPACK above this dense-size threshold
     dense_bytes_limit: int = 32 * 1024 * 1024
+    # iterations per device call: the host loops chunks until convergence.
+    # Bounding each XLA program keeps single long solves from hitting
+    # runtime watchdogs (a 100k-iteration year-long LP is minutes of
+    # uninterrupted device time otherwise) and gives progress visibility.
+    chunk_iters: int = 16384
     dtype: jnp.dtype = jnp.float32
     # TPU MXU default precision is bf16, which is NOT enough for PDHG to
     # converge (the iteration amplifies matvec rounding through the box
@@ -321,22 +327,16 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
         y1 = jnp.where(eq_mask, y1, jnp.maximum(y1, 0.0))
         return (x1, y1, x_sum + x1, y_sum + y1), None
 
-    def solve(op, c, q, l, u, dr, dc, eta):
+    def _context(op, c, q, l, u, dr, dc):
+        """Scaled problem data shared by init/chunk/finalize."""
         dtype = opts.dtype
         eq_mask = jnp.arange(m) < n_eq
-        # scale problem data into the preconditioned space
         c_s = (c * dc).astype(dtype)
         q_s = (q * dr).astype(dtype)
         l_s = jnp.where(jnp.isfinite(l), l / dc, l).astype(dtype)
         u_s = jnp.where(jnp.isfinite(u), u / dc, u).astype(dtype)
         q_norm = jnp.linalg.norm(q).astype(dtype) if m else jnp.asarray(0.0, dtype)
         c_norm = jnp.linalg.norm(c).astype(dtype) if n else jnp.asarray(0.0, dtype)
-
-        c_us = c.astype(dtype)
-        q_us = q.astype(dtype)
-        l_us = l.astype(dtype)
-        u_us = u.astype(dtype)
-
         # zero scalar *derived from the problem data* so that, under
         # shard_map, every loop-carried value inherits the data's
         # varying-over-mesh-axis type (plain constants would not and the
@@ -345,13 +345,6 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
                  + jnp.sum(jnp.where(jnp.isfinite(l_s), l_s, 0.0))
                  + jnp.sum(jnp.where(jnp.isfinite(u_s), u_s, 0.0))) * 0.0
         fzero = fzero.astype(dtype)
-        izero = fzero.astype(jnp.int32)
-        bfalse = fzero > 1.0
-
-        # start at the projection of 0 onto the box, in the scaled space
-        x0 = jnp.clip(jnp.zeros(n, dtype) + fzero, l_s, u_s)
-        y0 = jnp.zeros(m, dtype) + fzero
-
         # primal weight: ratio of objective to rhs magnitude in the scaled
         # space (PDLP's initialization) — battery LPs have tiny $-valued
         # duals against large kW/kWh primals, so omega << 1 is typical
@@ -359,21 +352,57 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
         q2 = jnp.linalg.norm(q_s)
         omega0 = jnp.where((c2 > 0) & (q2 > 0), c2 / jnp.maximum(q2, 1e-12),
                            1.0).astype(dtype)
-        omega_lo = omega0 / 50.0
-        omega_hi = omega0 * 50.0
+        return dict(dtype=dtype, eq_mask=eq_mask, c_s=c_s, q_s=q_s, l_s=l_s,
+                    u_s=u_s, q_norm=q_norm, c_norm=c_norm, fzero=fzero,
+                    c_us=c.astype(dtype), q_us=q.astype(dtype),
+                    l_us=l.astype(dtype), u_us=u.astype(dtype),
+                    omega0=omega0, omega_lo=omega0 / 50.0,
+                    omega_hi=omega0 * 50.0)
 
-        def check_scores(x, y):
-            return _kkt_terms(op, x, y, c_us, q_us, l_us, u_us, eq_mask, dr, dc,
-                              prec)
+    def init_state(op, c, q, l, u, dr, dc):
+        t = _context(op, c, q, l, u, dr, dc)
+        dtype = t["dtype"]
+        fzero = t["fzero"]
+        izero = fzero.astype(jnp.int32)
+        bfalse = fzero > 1.0
+        # start at the projection of 0 onto the box, in the scaled space
+        x0 = jnp.clip(jnp.zeros(n, dtype) + fzero, t["l_s"], t["u_s"])
+        y0 = jnp.zeros(m, dtype) + fzero
+        big = jnp.asarray(jnp.finfo(dtype).max, dtype) / 2 + fzero
+        return _State(
+            x=x0, y=y0,
+            x_sum=jnp.zeros(n, dtype) + fzero, y_sum=jnp.zeros(m, dtype) + fzero,
+            inner=izero, total=izero,
+            omega=t["omega0"] + fzero,
+            x_restart=x0, y_restart=y0,
+            mu_restart=big, mu_prev=big,
+            converged=bfalse,
+            done_x=x0, done_y=y0,
+            iters_at_conv=jnp.asarray(opts.max_iters, jnp.int32) + izero,
+            infeas_streak=izero,
+            infeasible=bfalse,
+        )
+
+    def run_chunk(op, c, q, l, u, dr, dc, eta, state, limit):
+        """Advance the restarted-PDHG loop until convergence, infeasibility
+        certification, or ``limit`` total iterations (traced)."""
+        t = _context(op, c, q, l, u, dr, dc)
+        dtype = t["dtype"]
+        eq_mask = t["eq_mask"]
+        c_s, q_s, l_s, u_s = t["c_s"], t["q_s"], t["l_s"], t["u_s"]
+        c_us, q_us, l_us, u_us = t["c_us"], t["q_us"], t["l_us"], t["u_us"]
+        q_norm, c_norm = t["q_norm"], t["c_norm"]
+        omega_lo, omega_hi = t["omega_lo"], t["omega_hi"]
 
         def mu_of(x, y):
-            pr, dr_, gp, po, do = check_scores(x, y)
+            pr, dr_, gp, po, do = _kkt_terms(op, x, y, c_us, q_us, l_us, u_us,
+                                             eq_mask, dr, dc, prec)
             denom = 1.0 + jnp.abs(po) + jnp.abs(do)
             return jnp.sqrt(pr * pr + dr_ * dr_ + (gp / denom) ** 2), (pr, dr_, gp, po, do)
 
         def cond(s: _State):
             return (~jnp.all(s.converged)) & (~s.infeasible) \
-                & (s.total < opts.max_iters)
+                & (s.total < limit)
 
         def body(s: _State):
             (x, y, x_sum, y_sum), _ = jax.lax.scan(
@@ -447,30 +476,20 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
                 infeasible=infeasible,
             )
 
-        big = jnp.asarray(jnp.finfo(dtype).max, dtype) / 2 + fzero
-        init = _State(
-            x=x0.astype(dtype), y=y0.astype(dtype),
-            x_sum=jnp.zeros(n, dtype) + fzero, y_sum=jnp.zeros(m, dtype) + fzero,
-            inner=izero, total=izero,
-            omega=omega0 + fzero,
-            x_restart=x0.astype(dtype), y_restart=y0.astype(dtype),
-            mu_restart=big, mu_prev=big,
-            converged=bfalse,
-            done_x=x0.astype(dtype), done_y=y0.astype(dtype),
-            iters_at_conv=jnp.asarray(opts.max_iters, jnp.int32) + izero,
-            infeas_streak=izero,
-            infeasible=bfalse,
-        )
-        final = jax.lax.while_loop(cond, body, init)
+        return jax.lax.while_loop(cond, body, state)
+
+    def finalize(op, c, q, l, u, dr, dc, final: _State) -> PDHGResult:
+        t = _context(op, c, q, l, u, dr, dc)
         # if never converged, report last iterate
         x_out = jnp.where(final.converged, final.done_x, final.x)
         y_out = jnp.where(final.converged, final.done_y, final.y)
-        pr, dr_, gp, po, do = _kkt_terms(op, x_out, y_out, c_us, q_us, l_us, u_us,
-                                         eq_mask, dr, dc, prec)
+        pr, dr_, gp, po, do = _kkt_terms(
+            op, x_out, y_out, t["c_us"], t["q_us"], t["l_us"], t["u_us"],
+            t["eq_mask"], dr, dc, prec)
         f = opts.inaccurate_factor
         loose = dataclasses.replace(opts, eps_abs=opts.eps_abs * f,
                                     eps_rel=opts.eps_rel * f)
-        near = _converged(pr, dr_, gp, po, do, q_norm, c_norm, loose)
+        near = _converged(pr, dr_, gp, po, do, t["q_norm"], t["c_norm"], loose)
         status = jnp.where(
             final.converged, STATUS_CONVERGED,
             jnp.where(final.infeasible, STATUS_PRIMAL_INFEASIBLE,
@@ -483,6 +502,19 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
             prim_res=pr, gap=gp, status=status,
         )
 
+    def solve(op, c, q, l, u, dr, dc, eta, limit=None):
+        """Single-call convenience: init + one chunk to ``limit`` (defaults
+        to max_iters) + finalize.  The host-chunked driver in
+        CompiledLPSolver uses the three pieces separately."""
+        if limit is None:
+            limit = opts.max_iters
+        state = init_state(op, c, q, l, u, dr, dc)
+        state = run_chunk(op, c, q, l, u, dr, dc, eta, state, limit)
+        return finalize(op, c, q, l, u, dr, dc, state)
+
+    solve.init_state = init_state
+    solve.run_chunk = run_chunk
+    solve.finalize = finalize
     return solve
 
 
@@ -523,10 +555,16 @@ class CompiledLPSolver:
         sigma_max = float(jnp.sqrt(norms[-1]))
         self.eta = jnp.asarray(self.opts.step_size_safety / max(sigma_max, 1e-12), dtype)
         self._solve = _make_solver(self.opts, lp.m, lp.n, lp.n_eq)
-        self._jit_single = jax.jit(self._solve)
-        self._jit_batch = jax.jit(
-            jax.vmap(self._solve,
-                     in_axes=(None, 0, 0, 0, 0, None, None, None)))
+        data_axes = (None, 0, 0, 0, 0, None, None)
+        self._jit_init = jax.jit(self._solve.init_state)
+        self._jit_chunk = jax.jit(self._solve.run_chunk)
+        self._jit_fin = jax.jit(self._solve.finalize)
+        self._jit_init_b = jax.jit(jax.vmap(self._solve.init_state,
+                                            in_axes=data_axes))
+        self._jit_chunk_b = jax.jit(jax.vmap(self._solve.run_chunk,
+                                             in_axes=data_axes + (None, 0, None)))
+        self._jit_fin_b = jax.jit(jax.vmap(self._solve.finalize,
+                                           in_axes=data_axes + (0,)))
 
     def _data(self, c, q, l, u):
         lp = self.lp
@@ -539,8 +577,7 @@ class CompiledLPSolver:
     def solve(self, c=None, q=None, l=None, u=None) -> PDHGResult:
         c, q, l, u = self._data(c, q, l, u)
         if all(arr.ndim == 1 for arr in (c, q, l, u)):
-            return self._jit_single(self.op, c, q, l, u, self.dr, self.dc,
-                                    self.eta)
+            return self._drive(c, q, l, u, batched=False)
         if any(arr.ndim not in (1, 2) for arr in (c, q, l, u)):
             raise ValueError("solve() inputs must be 1-D (shared) or 2-D (batched)")
         sizes = {arr.shape[0] for arr in (c, q, l, u) if arr.ndim == 2}
@@ -548,8 +585,30 @@ class CompiledLPSolver:
             raise ValueError(f"inconsistent batch sizes in solve(): {sorted(sizes)}")
         B = sizes.pop()
         c, q, l, u = self.batch_data(B, c, q, l, u)
-        return self._jit_batch(self.op, c, q, l, u, self.dr, self.dc,
-                               self.eta)
+        return self._drive(c, q, l, u, batched=True)
+
+    def _drive(self, c, q, l, u, batched: bool) -> PDHGResult:
+        """Host-chunked driver: bounded device calls until every instance
+        converges, certifies infeasibility, or hits max_iters.  Keeps a
+        single XLA program short (runtime watchdogs kill multi-minute
+        device steps) and gives chunk-level progress."""
+        init = self._jit_init_b if batched else self._jit_init
+        chunk = self._jit_chunk_b if batched else self._jit_chunk
+        fin = self._jit_fin_b if batched else self._jit_fin
+        args = (self.op, c, q, l, u, self.dr, self.dc)
+        state = init(*args)
+        max_iters = self.opts.max_iters
+        total = 0
+        while True:
+            limit = np.int32(min(total + self.opts.chunk_iters, max_iters))
+            state = chunk(*args, self.eta, state, limit)
+            totals = np.asarray(state.total)
+            total = int(totals.max())
+            active = ~(np.asarray(state.converged)
+                       | np.asarray(state.infeasible))
+            if not active.any() or total >= max_iters:
+                break
+        return fin(*args, state)
 
     def batch_data(self, B: int, c, q, l, u):
         """Broadcast any shared 1-D arrays up to the batch dimension."""

@@ -91,6 +91,10 @@ class CaseResult:
         if rel is not None:
             self.drill_down_dict.update(
                 rel.drill_down_reports(s.ders, self.time_series_data))
+        for der in s.ders:
+            report = getattr(der, "degradation_report", lambda: None)()
+            if report is not None:
+                self.drill_down_dict[f"degradation_data_{der.name}"] = report
 
     def calculate_cba(self) -> None:
         from ..financial.cba import CostBenefitAnalysis
@@ -107,6 +111,10 @@ class CaseResult:
         self.npv_df = cba.npv
         self.payback_df = cba.payback
         self.cost_benefit_df = cba.cost_benefit
+        self.equipment_lifetimes_df = cba.equipment_lifetime_report(s.ders)
+        self.tax_breakdown_df = cba.tax_breakdown
+        ecc = getattr(cba, "ecc_breakdown", None)
+        self.ecc_breakdown_df = pd.DataFrame(ecc) if ecc else None
 
     # ------------------------------------------------------------------
     def save_as_csv(self, path: Path, label: str = "") -> None:
@@ -123,6 +131,9 @@ class CaseResult:
         put("npv", self.npv_df, index=False)
         put("payback", self.payback_df, index=False)
         put("cost_benefit", self.cost_benefit_df)
+        put("equipment_lifetimes", getattr(self, "equipment_lifetimes_df", None))
+        put("tax_breakdown", getattr(self, "tax_breakdown_df", None))
+        put("ecc_breakdown", getattr(self, "ecc_breakdown_df", None))
         for name, df in self.drill_down_dict.items():
             put(name, df)
         TellUser.info(f"results saved to {path}")

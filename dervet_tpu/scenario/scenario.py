@@ -121,6 +121,10 @@ class MicrogridScenario:
                 raise ParameterError(f"unknown value stream tag {tag!r}")
             self.streams[tag] = cls(keys, self.scenario, case.datasets)
 
+        # lifecycle horizon must be known BEFORE dispatch so that
+        # grab_active_ders can drop equipment past its end of life
+        for der in self.ders:
+            der.set_failure_years(self.end_year, self.start_year)
         self.poi = POI(self.scenario, self.ders)
         self.service_agg = ServiceAggregator(self.streams)
         self.windows = make_windows(self.index, self.time_series,
@@ -170,11 +174,20 @@ class MicrogridScenario:
         if self.poi.is_sizing_optimization:
             # solve the first window with size variables, freeze the sizes,
             # then batch the remaining windows at fixed size (reference:
-            # der.set_size() after window 1, MicrogridScenario.py:361-363)
+            # der.set_size() after window 1, MicrogridScenario.py:361-363).
+            # The sizing LP runs on the exact CPU simplex regardless of
+            # backend: it is ONE hard, badly-scaled LP solved once per run
+            # (size vars ~1e4 against $/kWh costs ~1e-2 stall f32 PDHG),
+            # while the TPU's advantage is the batched operational axis —
+            # the division of labor SURVEY §2.9 prescribes.
+            if backend != "cpu":
+                TellUser.info("sizing window routed to the CPU exact solver; "
+                              "operational windows stay on the batched "
+                              f"{backend} backend")
             self._solve_subgroup(
                 [(windows[0], self.build_window_lp(windows[0], annuity_scalar,
                                                    requirements))],
-                backend, solver_opts, solution, freeze_sizes=True)
+                "cpu", solver_opts, solution, freeze_sizes=True)
             n_solves += 1
             windows = windows[1:]
             # capacity-dependent requirements (Reliability min-SOE, RA
@@ -183,6 +196,25 @@ class MicrogridScenario:
             # windows are constrained correctly
             requirements = self.service_agg.identify_system_requirements(
                 self.ders, self.opt_years, self.index)
+        degrading = [d for d in self.ders
+                     if getattr(d, "incl_cycle_degrade", False)]
+        if degrading:
+            # cycle degradation couples consecutive windows through the SOH
+            # state (reference Battery.py:87-110; SURVEY §7 hard part #3) —
+            # solve windows sequentially in time order, updating SOH (and
+            # therefore the next window's energy bounds) after each
+            for ctx in windows:
+                self._solve_subgroup(
+                    [(ctx, self.build_window_lp(ctx, annuity_scalar,
+                                                requirements))],
+                    backend, solver_opts, solution)
+                n_solves += 1
+                pos = np.searchsorted(self.index, ctx.index[0])
+                for d in degrading:
+                    arr = solution.get(f"{d.tag}-{d.id or '1'}/ene")
+                    if arr is not None:
+                        d.calc_degradation(ctx.index, arr[pos:pos + ctx.T])
+            windows = []
         groups = group_by_length(windows)
         for T, ctxs in sorted(groups.items()):
             built = [(ctx, self.build_window_lp(ctx, annuity_scalar, requirements))

@@ -1,0 +1,92 @@
+"""DER lifecycle / DERExtension surface: failure years, replacements,
+equipment lifetimes, dead-DER zero-out, ECC substitution.
+
+Spec: dervet/MicrogridDER/DERExtension.py:86-306 + CBA.py:348-438; the
+Usecase1 equipment_lifetimes golden fixes the report semantics
+(Beginning of Life = construction year, End of Life = operation year +
+expected lifetime - 1 for non-replaceable equipment).
+"""
+from pathlib import Path
+
+import pandas as pd
+import pytest
+
+from dervet_tpu.api import DERVET
+from dervet_tpu.financial.cba import CostBenefitAnalysis
+from dervet_tpu.models.der.ess import Battery
+
+REF = Path("/root/reference")
+UC1 = REF / "test/test_validation_report_sept1/Model_params/Usecase1"
+
+
+def _battery(**keys):
+    base = {"name": "bat", "ene_max_rated": 100, "ch_max_rated": 50,
+            "dis_max_rated": 50, "rte": 85, "ulsoc": 100, "llsoc": 0}
+    base.update(keys)
+    return Battery(base, {"dt": 1})
+
+
+def test_failure_years_non_replaceable():
+    b = _battery(operation_year=2017, expected_lifetime=5, replaceable=0)
+    assert b.set_failure_years(2030) == [2021]
+    assert b.last_operation_year == 2021
+    assert b.operational(2021) and not b.operational(2022)
+
+
+def test_failure_years_replaceable():
+    b = _battery(operation_year=2017, expected_lifetime=5, replaceable=1)
+    assert b.set_failure_years(2030) == [2021, 2026]
+    assert b.last_operation_year == 2030
+    assert b.operational(2030)
+
+
+def test_replacement_cost_components():
+    b = _battery(rcost=1000, rcost_kW=10, rcost_kWh=2)
+    assert b.replacement_cost() == 1000 + 10 * 50 + 2 * 100
+
+
+def test_replacement_rows_in_proforma():
+    b = _battery(operation_year=2017, expected_lifetime=5, replaceable=1,
+                 rcost_kW=100, ter=7, ccost_kw=100)
+    cba = CostBenefitAnalysis({"npv_discount_rate": 7, "inflation_rate": 3},
+                              2017, 2030, [2017])
+    cols = cba._der_columns(b, [2017], pd.DataFrame())
+    rep = cols["BATTERY: bat Replacement Costs"]
+    # failure 2021 -> paid 2021+1-1(construction time)=2021, escalated at ter
+    assert rep[2021] == pytest.approx(-100 * 50 * 1.07 ** 4)
+    assert rep[2026] == pytest.approx(-100 * 50 * 1.07 ** 9)
+
+
+def test_equipment_lifetimes_golden_semantics():
+    """Battery in Usecase1: construction 2016, operation 2017,
+    lifetime 100 -> EoL 2116 (golden equipment_lifetimesuc3.csv)."""
+    b = _battery(construction_year=2016, operation_year=2017,
+                 expected_lifetime=100, replaceable=0)
+    row = b.equipment_lifetime_row(2037)
+    assert row == {"Beginning of Life": 2016, "Operation Begins": 2017,
+                   "End of Life": 2116}
+
+
+def test_ecc_substitution():
+    b = _battery(operation_year=2017, expected_lifetime=4, ccost_kw=100,
+                 **{"ecc%": 10})
+    cba = CostBenefitAnalysis({"npv_discount_rate": 7, "inflation_rate": 0,
+                               "ecc_mode": 1}, 2017, 2026, [2017])
+    pf = pd.DataFrame(0.0, index=["CAPEX Year"] + list(range(2017, 2027)),
+                      columns=["BATTERY: bat Capital Cost"])
+    pf.loc["CAPEX Year"] = -5000.0
+    out = cba._ecc_substitution(pf, [b])
+    assert (out["BATTERY: bat Capital Cost"] == 0).all()
+    cc = out["BATTERY: bat Carrying Cost"]
+    assert cc[2017] == pytest.approx(-b.get_capex() * 0.10)
+    assert cc[2020] != 0 and cc[2021] == 0
+
+
+def test_equipment_lifetimes_saved(tmp_path):
+    d = DERVET(UC1 / "Model_Parameters_Template_Usecase1_UnPlanned_ES.csv",
+               base_path=REF)
+    res = d.solve(backend="cpu")
+    res.save_as_csv(tmp_path)
+    el = pd.read_csv(tmp_path / "equipment_lifetimes.csv", index_col=0)
+    assert "BATTERY: ES" in el.columns
+    assert int(el.loc["End of Life", "BATTERY: ES"]) == 2116
