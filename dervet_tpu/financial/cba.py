@@ -290,7 +290,8 @@ class CostBenefitAnalysis:
     def equipment_lifetime_report(self, ders) -> pd.DataFrame:
         """Beginning of Life / Operation Begins / End of Life per DER
         (reference CBA.py:525-536; golden equipment_lifetimes CSV)."""
-        cols = {d.unique_tech_id: d.equipment_lifetime_row(self.end_year)
+        cols = {d.unique_tech_id:
+                d.equipment_lifetime_row(self.end_year, self.start_year)
                 for d in ders}
         return pd.DataFrame(cols)
 

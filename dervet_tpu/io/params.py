@@ -48,7 +48,11 @@ def convert_value(raw: Any, declared: str, key: str = "") -> Any:
             return False
         raise ModelParameterError(f"cannot parse bool {raw!r} for {key}")
     if declared == "Period":
-        return int(float(s))
+        try:
+            return int(float(s))
+        except ValueError:
+            # some reference inputs use date strings, e.g. '1/1/2017'
+            return int(pd.to_datetime(s).year)
     if declared == "list/int":
         # reference inputs separate list items with commas OR whitespace
         parts = s.replace("[", "").replace("]", "").replace(",", " ").split()

@@ -161,9 +161,11 @@ class RotatingGenerator(DER):
         return None
 
     def sizing_summary(self) -> Dict:
+        # Power Capacity is PER UNIT (golden size CSV: ice gen 750 kW x
+        # Quantity 2)
         return {
             "DER": self.name,
-            "Power Capacity (kW)": self.max_power_out,
+            "Power Capacity (kW)": self.rated_power,
             "Capital Cost ($)": self.ccost,
             "Capital Cost ($/kW)": self.ccost_kw,
             "Quantity": self.n_units,

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pandas as pd
+import scipy.sparse as sp
 
 from ...ops.lp import LPBuilder
 from ...scenario.window import WindowContext, grab_column
@@ -137,6 +138,7 @@ class Reliability(ValueStream):
         self.outage_contribution_df: Optional[pd.DataFrame] = None
         self.outage_soe_profile: Optional[pd.DataFrame] = None
         self.dg_rating = 0.0                          # n-2 reserve margin
+        self.use_sizing_module_results = False
 
     # ------------------------------------------------------------------
     def _prepare(self, index: pd.DatetimeIndex) -> None:
@@ -216,6 +218,191 @@ class Reliability(ValueStream):
         return np.asarray(cov), np.asarray(prof)
 
     # ------------------------------------------------------------------
+    # reliability-driven sizing (reference Reliability.py:153-274):
+    # iterate {min-capex LP covering candidate outages} -> {vectorized
+    # walk to find the first uncovered start} until everything is covered.
+    # The reference's GLPK_MI integer sizing relaxes to a continuous LP
+    # (SURVEY §7); its recursive 500-at-a-time uncovered search becomes
+    # one vmapped walk over every start.
+    # ------------------------------------------------------------------
+    def sizing_module(self, ders, index: pd.DatetimeIndex,
+                      max_rounds: int = 40):
+        self._prepare(index)
+        from ...ops import cpu_ref
+        T = len(index)
+        L = self.coverage_steps
+        candidates = [int(i) for i in np.argsort(-self.requirement)[:10]]
+        sizes = {}
+        for round_no in range(max_rounds):
+            sizes = self._size_for_outages(ders, index, candidates)
+            self._apply_sizes(ders, sizes, freeze=False)
+            mix = self._der_mix(ders)
+            p = mix["props"]
+            init = np.full(T, self.soc_init * p["energy rating"])
+            cov, _ = self._walk(mix, init, L)
+            cov = np.minimum(cov, T - np.arange(T))
+            uncovered = np.nonzero((cov < L) & (cov < (T - np.arange(T))))[0]
+            if not len(uncovered):
+                TellUser.info(f"reliability sizing converged after "
+                              f"{round_no + 1} round(s): "
+                              f"{ {k: round(v, 1) for k, v in sizes.items()} }")
+                break
+            first = int(uncovered[0])
+            if first in candidates:
+                TellUser.warning("reliability sizing: first uncovered outage "
+                                 f"at {first} already constrained; stopping")
+                break
+            candidates.append(first)
+        self._apply_sizes(ders, sizes, freeze=True)
+        self.use_sizing_module_results = True
+        self.min_soe_schedule(ders, index)
+        return ders
+
+    @staticmethod
+    def _apply_sizes(ders, sizes: Dict[str, float], freeze: bool) -> None:
+        """Push solved sizes onto the DERs.  During the iteration the
+        ratings update but the sizing FLAGS stay on (the next round's LP
+        must keep them variable); only the final call freezes via
+        set_size."""
+        for d in ders:
+            der_sizes = {k.split("/")[-1]: v for k, v in sizes.items()
+                         if k.startswith(f"{d.tag}-{d.id or '1'}/")}
+            if not der_sizes:
+                continue
+            if freeze:
+                d.set_size(der_sizes)
+                continue
+            if "size_ene" in der_sizes:
+                d.ene_max_rated = der_sizes["size_ene"]
+            if "size_dis" in der_sizes:
+                d.dis_max_rated = der_sizes["size_dis"]
+                if getattr(d, "sizing_ch", False):
+                    d.ch_max_rated = der_sizes["size_dis"]
+            if "size" in der_sizes:
+                if hasattr(d, "rated_power"):
+                    d.rated_power = der_sizes["size"]
+                else:
+                    d.rated_capacity = der_sizes["size"]
+
+    def _size_for_outages(self, ders, index: pd.DatetimeIndex,
+                          starts: List[int]) -> Dict[str, float]:
+        """Min-capex LP: chosen sizes must cover every candidate outage
+        window (reference size_for_outages, Reliability.py:221-274)."""
+        from ...ops.lp import LPBuilder
+        from ...ops import cpu_ref
+        b = LPBuilder()
+        T = len(index)
+        L = self.coverage_steps
+        dt = self.dt
+        crit_full = self.critical_load.to_numpy()
+
+        ess = [d for d in ders
+               if d.technology_type == "Energy Storage System"]
+        pvs = [d for d in ders if d.technology_type == "Intermittent Resource"]
+        gens = [d for d in ders if d.technology_type == "Generator"]
+
+        # ---- size variables / numeric ratings -------------------------
+        size_refs: Dict[str, object] = {}
+        for e in ess:
+            if getattr(e, "sizing_ene", False):
+                ref = b.var(e.vname("size_ene"), 1, lb=0.0)
+                size_refs[e.vname("size_ene")] = ref
+                b.add_cost(ref, float(e.ccost_kwh))
+            if getattr(e, "sizing_ch", False) or getattr(e, "sizing_dis", False):
+                ref = b.var(e.vname("size_dis"), 1, lb=0.0)
+                size_refs[e.vname("size_dis")] = ref
+                b.add_cost(ref, float(e.ccost_kw))
+        for g in gens:
+            if g.being_sized():
+                ref = b.var(g.vname("size"), 1, lb=0.0)
+                size_refs[g.vname("size")] = ref
+                b.add_cost(ref, float(g.ccost_kw) * g.n_units)
+        for pv in pvs:
+            if pv.being_sized():
+                ref = b.var(pv.vname("size"), 1, lb=0.0)
+                size_refs[pv.vname("size")] = ref
+                b.add_cost(ref, float(pv.cost_per_kw))
+
+        # ---- per-outage coverage blocks -------------------------------
+        for k, s0 in enumerate(sorted(set(int(s) for s in starts))):
+            Lk = int(min(L, T - s0))
+            if Lk <= 0:
+                continue
+            crit = crit_full[s0:s0 + Lk].copy()
+            if self.load_shed and self.load_shed_data is not None:
+                shed = self.load_shed_data[:Lk]
+                crit[:len(shed)] = crit[:len(shed)] * shed / 100.0
+            balance = []          # terms summing to supply (kW)
+            const_supply = np.zeros(Lk)
+            for e in ess:
+                ch = b.var(f"o{k}/{e.vname('ch')}", Lk, lb=0.0)
+                dis = b.var(f"o{k}/{e.vname('dis')}", Lk, lb=0.0)
+                ene = b.var(f"o{k}/{e.vname('ene')}", Lk, lb=0.0)
+                diag = sp.diags([np.ones(Lk), -np.ones(Lk - 1)],
+                                offsets=[0, -1], format="csr")
+                soe_terms = [(ene, diag), (ch, -e.rte * dt), (dis, dt)]
+                first_col = sp.csr_matrix(
+                    (np.ones(1), (np.zeros(1, int), np.zeros(1, int))),
+                    shape=(Lk, 1))
+                if e.vname("size_ene") in size_refs:
+                    se = size_refs[e.vname("size_ene")]
+                    soe_terms.append((se, first_col * (-self.soc_init)))
+                    b.add_rows(f"o{k}/{e.vname('soe')}", soe_terms, "eq", 0.0)
+                    b.add_rows(f"o{k}/{e.vname('ene_ub')}",
+                               [(ene, 1.0), (se, -e.ulsoc * np.ones((Lk, 1)))],
+                               "le", 0.0)
+                else:
+                    rhs = np.zeros(Lk)
+                    rhs[0] = self.soc_init * e.energy_capacity()
+                    b.add_rows(f"o{k}/{e.vname('soe')}", soe_terms, "eq", rhs)
+                    b.set_bounds(ene, lb=e.operational_min_energy(),
+                                 ub=e.operational_max_energy())
+                if e.vname("size_dis") in size_refs:
+                    sd = size_refs[e.vname("size_dis")]
+                    b.add_rows(f"o{k}/{e.vname('ch_ub')}",
+                               [(ch, 1.0), (sd, -np.ones((Lk, 1)))], "le", 0.0)
+                    b.add_rows(f"o{k}/{e.vname('dis_ub')}",
+                               [(dis, 1.0), (sd, -np.ones((Lk, 1)))], "le", 0.0)
+                else:
+                    b.set_bounds(ch, ub=e.charge_capacity())
+                    b.set_bounds(dis, ub=e.discharge_capacity())
+                balance.extend([(dis, np.ones(Lk)), (ch, -np.ones(Lk))])
+            for g in gens:
+                elec = b.var(f"o{k}/{g.vname('elec')}", Lk, lb=0.0)
+                if g.vname("size") in size_refs:
+                    sg = size_refs[g.vname("size")]
+                    b.add_rows(f"o{k}/{g.vname('cap')}",
+                               [(elec, 1.0),
+                                (sg, -float(g.n_units) * np.ones((Lk, 1)))],
+                               "le", 0.0)
+                else:
+                    b.set_bounds(elec, ub=g.max_power_out)
+                balance.append((elec, np.ones(Lk)))
+            for pv in pvs:
+                per_kw = np.asarray(grab_column(
+                    self.datasets.time_series.loc[index],
+                    "PV Gen (kW/rated kW)", pv.id))[s0:s0 + Lk]
+                nu = getattr(pv, "nu", 1.0)
+                if pv.vname("size") in size_refs:
+                    sp_ref = size_refs[pv.vname("size")]
+                    balance.append((sp_ref, (nu * per_kw)[:, None]))
+                else:
+                    const_supply += nu * per_kw * pv.rated_capacity
+            if not balance:
+                raise TimeseriesDataError(
+                    "reliability sizing needs at least one dispatchable DER")
+            b.add_rows(f"o{k}/balance", balance, "ge", crit - const_supply)
+
+        lp = b.build()
+        res = cpu_ref.solve_lp_cpu(lp)
+        if res.status != 0:
+            raise TimeseriesDataError(
+                "reliability sizing LP failed: "
+                f"{getattr(res, 'message', 'solver failure')}")
+        return {name: float(res.x[ref.sl][0])
+                for name, ref in lp.var_refs.items() if name in size_refs}
+
+    # ------------------------------------------------------------------
     # pre-dispatch: min-SOE schedule -> system requirement
     # ------------------------------------------------------------------
     def min_soe_schedule(self, ders, index: pd.DatetimeIndex) -> Optional[pd.DataFrame]:
@@ -285,7 +472,12 @@ class Reliability(ValueStream):
         T = len(index)
         L = int(np.round(self.max_outage_duration / self.dt))
         if p["energy rating"] > 0:
-            if "Aggregated State of Energy (kWh)" in results and \
+            if self.use_sizing_module_results and self.min_soe_df is not None \
+                    and "Aggregated State of Energy (kWh)" not in results:
+                # no dispatch ran: start each outage from the min-SOE
+                # schedule (reference Reliability.py:905-911)
+                init = self.min_soe_df["soe"].to_numpy()
+            elif "Aggregated State of Energy (kWh)" in results and \
                     not self.post_facto_only:
                 init = results["Aggregated State of Energy (kWh)"].to_numpy()
             else:

@@ -81,11 +81,26 @@ def solve_batch_sharded(solver: CompiledLPSolver, mesh: Mesh,
 
     valid = (jnp.arange(B_pad) < B).astype(jnp.int32)
 
-    vsolve = jax.vmap(solver._solve,
-                      in_axes=(None, 0, 0, 0, 0, None, None, None))
+    # the same host-chunked init/chunk/finalize driver as the single-host
+    # path, each stage shard_map-ed over the scenario axis — a sharded
+    # solve is still a sequence of bounded device steps (watchdog-safe,
+    # chunk-level progress), not one multi-minute XLA program
+    vinit = jax.vmap(solver._solve.init_state,
+                     in_axes=(None, 0, 0, 0, 0, None, None))
+    vchunk = jax.vmap(solver._solve.run_chunk,
+                      in_axes=(None, 0, 0, 0, 0, None, None, None, 0, None))
+    vfin = jax.vmap(solver._solve.finalize,
+                    in_axes=(None, 0, 0, 0, 0, None, None, 0))
 
-    def local_solve(c, q, l, u, valid):
-        res = vsolve(solver.op, c, q, l, u, solver.dr, solver.dc, solver.eta)
+    def local_init(c, q, l, u):
+        return vinit(solver.op, c, q, l, u, solver.dr, solver.dc)
+
+    def local_chunk(c, q, l, u, state, limit):
+        return vchunk(solver.op, c, q, l, u, solver.dr, solver.dc,
+                      solver.eta, state, limit)
+
+    def local_fin(c, q, l, u, state, valid):
+        res = vfin(solver.op, c, q, l, u, solver.dr, solver.dc, state)
         stats = ShardedStats(
             n_converged=jax.lax.psum(
                 jnp.sum(res.converged.astype(jnp.int32) * valid), AXIS),
@@ -95,16 +110,31 @@ def solve_batch_sharded(solver: CompiledLPSolver, mesh: Mesh,
         )
         return res, stats
 
-    shmapped = jax.shard_map(
-        local_solve, mesh=mesh,
-        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
-        out_specs=(PDHGResult(x=P(AXIS), y=P(AXIS), obj=P(AXIS),
-                              converged=P(AXIS), iters=P(AXIS),
-                              prim_res=P(AXIS), gap=P(AXIS), status=P(AXIS)),
-                   ShardedStats(n_converged=P(), max_iters=P(),
-                                max_prim_res=P())),
-    )
-    res, stats = jax.jit(shmapped)(c, q, l, u, valid)
+    res_specs = PDHGResult(x=P(AXIS), y=P(AXIS), obj=P(AXIS),
+                           converged=P(AXIS), iters=P(AXIS),
+                           prim_res=P(AXIS), gap=P(AXIS), status=P(AXIS))
+    sh_init = jax.jit(jax.shard_map(
+        local_init, mesh=mesh, in_specs=(P(AXIS),) * 4, out_specs=P(AXIS)))
+    sh_chunk = jax.jit(jax.shard_map(
+        local_chunk, mesh=mesh,
+        in_specs=(P(AXIS),) * 4 + (P(AXIS), P()), out_specs=P(AXIS)))
+    sh_fin = jax.jit(jax.shard_map(
+        local_fin, mesh=mesh, in_specs=(P(AXIS),) * 4 + (P(AXIS), P(AXIS)),
+        out_specs=(res_specs, ShardedStats(n_converged=P(), max_iters=P(),
+                                           max_prim_res=P()))))
+
+    opts = solver.opts
+    state = sh_init(c, q, l, u)
+    total = 0
+    while True:
+        limit = jnp.asarray(min(total + opts.chunk_iters, opts.max_iters),
+                            jnp.int32)
+        state = sh_chunk(c, q, l, u, state, limit)
+        total = int(np.asarray(state.total).max())
+        active = ~(np.asarray(state.converged) | np.asarray(state.infeasible))
+        if not active.any() or total >= opts.max_iters:
+            break
+    res, stats = sh_fin(c, q, l, u, state, valid)
     if B_pad != B:
         res = PDHGResult(*(a[:B] for a in res))
     return res, stats

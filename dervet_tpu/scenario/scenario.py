@@ -147,12 +147,68 @@ class MicrogridScenario:
         return b.build()
 
     # ------------------------------------------------------------------
+    def sizing_module(self) -> None:
+        """Pre-dispatch sizing decisions (reference
+        MicrogridScenario.sizing_module, :158-206): reliability-driven
+        sizing runs its own module then disables dispatch-based sizing;
+        deferral sizing floors the ESS ratings; reliability-only cases skip
+        the dispatch engine entirely."""
+        rel = self.streams.get("Reliability")
+        deferral = self.streams.get("Deferral")
+        if self.poi.is_sizing_optimization:
+            if deferral is not None:
+                if len(self.ders) != 1 or \
+                        self.ders[0].technology_type != "Energy Storage System":
+                    raise ParameterError(
+                        "sizing for deferral is only implemented for a "
+                        "single-ESS case (reference restriction)")
+                deferral.deferral_analysis(self.ders, self.opt_years,
+                                           self.end_year)
+                self._deferral_set_min_size(deferral)
+            if rel is not None and not rel.post_facto_only:
+                n_ess = sum(d.technology_type == "Energy Storage System"
+                            for d in self.ders)
+                if n_ess > 1:
+                    raise ParameterError("multi-ESS reliability sizing is "
+                                         "not implemented (reference "
+                                         "restriction)")
+                if rel.outage_duration <= self.dt:
+                    raise ParameterError(
+                        f"reliability target must exceed dt={self.dt}h")
+                rel.sizing_module(self.ders, self.index)
+                self.poi.is_sizing_optimization = False
+            else:
+                pass  # dispatch-based sizing checks run in the opt loop
+        if self.service_agg.is_reliability_only() or \
+                self.service_agg.post_facto_reliability_only_and_user_defined_constraints():
+            if rel is not None:
+                rel.use_sizing_module_results = True
+            self.opt_engine = False
+
+    def _deferral_set_min_size(self, deferral) -> None:
+        """Deferral requirements floor the ESS size variables (reference
+        MicrogridServiceAggregator.set_size, :81-107)."""
+        dd = deferral.deferral_df
+        if dd is None or not len(dd):
+            return
+        p_req = float(dd["Power Requirement (kW)"].iloc[0])
+        e_req = float(dd["Energy Requirement (kWh)"].iloc[0])
+        ess = self.ders[0]
+        lo_e, hi_e = ess.user_bounds["ene"]
+        lo_d, hi_d = ess.user_bounds["dis"]
+        ess.user_bounds["ene"] = (max(lo_e, e_req), hi_e)
+        ess.user_bounds["dis"] = (max(lo_d, p_req), hi_d)
+        ess.user_bounds["ch"] = (max(ess.user_bounds["ch"][0], p_req),
+                                 ess.user_bounds["ch"][1])
+
+    # ------------------------------------------------------------------
     def optimize_problem_loop(self, backend: str = "jax",
                               solver_opts=None) -> None:
         """Group windows by length, batch-solve each group, scatter results."""
+        self.sizing_module()
         t0 = time.time()
         deferral = self.streams.get("Deferral")
-        if deferral is not None:
+        if deferral is not None and deferral.deferral_df is None:
             deferral.deferral_analysis(self.ders, self.opt_years, self.end_year)
         requirements = self.service_agg.identify_system_requirements(
             self.ders, self.opt_years, self.index)
@@ -189,6 +245,14 @@ class MicrogridScenario:
                                                    requirements))],
                 "cpu", solver_opts, solution, freeze_sizes=True)
             n_solves += 1
+            pos0 = np.searchsorted(self.index, windows[0].index[0])
+            for d in self.ders:
+                if getattr(d, "incl_cycle_degrade", False):
+                    arr = solution.get(f"{d.tag}-{d.id or '1'}/ene")
+                    if arr is not None:
+                        d.calc_degradation(
+                            windows[0].index,
+                            arr[pos0:pos0 + windows[0].T])
             windows = windows[1:]
             # capacity-dependent requirements (Reliability min-SOE, RA
             # qualifying capacity) were computed against zero ratings;

@@ -42,6 +42,68 @@ class TestUsecase1EsSizing:
         assert "load_coverage_prob" in es_case.drill_down_dict
 
 
+UC2 = REF / "test/test_validation_report_sept1/Model_params/Usecase2"
+RES2 = REF / "test/test_validation_report_sept1/Results/Usecase2"
+
+
+class TestUsecase2ReliabilitySizing:
+    """1 ESS sized for reliability only — planned outage (reference:
+    TestUseCase2EssSizing4Reliability, step1 goldens)."""
+
+    @pytest.fixture(scope="class")
+    def case(self):
+        d = DERVET(UC2 / "Model_Parameters_Template_Usecase3_Planned_ES.csv",
+                   base_path=REF)
+        return d.solve(backend="cpu").instances[0]
+
+    def test_size_within_bound(self, case):
+        compare_size_results(case, RES2 / "es/step1/sizeuc3_es_step1.csv",
+                             MAX_PERCENT_ERROR)
+
+    def test_lcpc_exists(self, case):
+        assert "load_coverage_prob" in case.drill_down_dict
+
+
+class TestUsecase2EsPvDgSizing:
+    """ESS+PV+DG sized for reliability — unplanned outage (reference:
+    Usecase2 es+pv+dg step1)."""
+
+    @pytest.fixture(scope="class")
+    def case(self):
+        d = DERVET(
+            UC2 / "Model_Parameters_Template_Usecase3_UnPlanned_ES+PV+DG_Step1.csv",
+            base_path=REF)
+        return d.solve(backend="cpu").instances[0]
+
+    def test_size_within_bound(self, case):
+        compare_size_results(
+            case, RES2 / "es+pv+dg/step1/sizeuc3_es+pv+dg_step1.csv",
+            MAX_PERCENT_ERROR)
+
+
+LS = REF / "test/test_load_shedding"
+
+
+class TestLoadShedding:
+    """Reliability with/without load shedding, fixed size + sizing
+    (reference: test_reliability_module.py classes, 3% bounds)."""
+
+    @pytest.mark.parametrize("mp,golden", [
+        ("mp/Model_Parameters_Template_DER_w_ls1.csv",
+         "results/reliability_load_shed1"),
+        ("mp/Model_Parameters_Template_DER_wo_ls1.csv",
+         "results/reliability_load_shed_wo_ls1"),
+        ("mp/Sizing/Model_Parameters_Template_DER_w_ls1.csv",
+         "results/Sizing/w_ls1"),
+    ])
+    def test_size_and_lcpc(self, mp, golden):
+        inst = DERVET(LS / mp, base_path=REF).solve(
+            backend="cpu").instances[0]
+        compare_size_results(inst, LS / golden / "size_2mw_5hr.csv",
+                             MAX_PERCENT_ERROR)
+        assert "load_coverage_prob" in inst.drill_down_dict
+
+
 @pytest.fixture(scope="module")
 def es_pv_case():
     d = DERVET(UC1 / "Model_Parameters_Template_Usecase1_UnPlanned_ES+PV.csv",
