@@ -1,0 +1,40 @@
+"""``python -m dervet_tpu`` / ``dervet-tpu`` console entry (mirrors
+reference run_DERVET.py:73-92)."""
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    from .api import DERVET
+
+    parser = argparse.ArgumentParser(
+        prog="dervet-tpu",
+        description="TPU-native DER valuation: dispatch optimization, sizing, "
+                    "reliability, and cost-benefit analysis")
+    parser.add_argument("parameters_filename",
+                        help="model parameters CSV/JSON file")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("--backend", default="jax", choices=["jax", "cpu"],
+                        help="dispatch solver backend (jax = batched PDHG on "
+                             "TPU; cpu = scipy HiGHS cross-validation path)")
+    parser.add_argument("--base-path", default=None,
+                        help="root for relative referenced-data paths "
+                             "(default: the parameters file's directory)")
+    parser.add_argument("--out", default=None,
+                        help="override results output directory")
+    parser.add_argument("--checkpoint-dir", default=None,
+                        help="directory for per-window solve checkpoints "
+                             "(resume an interrupted run from here)")
+    args = parser.parse_args(argv)
+
+    case = DERVET(args.parameters_filename, verbose=args.verbose,
+                  base_path=args.base_path)
+    results = case.solve(backend=args.backend,
+                         checkpoint_dir=args.checkpoint_dir)
+    results.save_as_csv(args.out)
+    return results
+
+
+if __name__ == "__main__":
+    main()

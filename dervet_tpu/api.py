@@ -28,15 +28,24 @@ class DERVET:
         TellUser.info(f"Initialized {len(self.cases)} case(s) from "
                       f"{model_parameters_path}")
 
-    def solve(self, backend: str = "jax", solver_opts=None):
+    def solve(self, backend: str = "jax", solver_opts=None,
+              checkpoint_dir=None):
         from .results.result import Result
+        if self.verbose:
+            from .io.summary import class_summary
+            class_summary(self.cases)
         results = Result.initialize(self.cases)
         for key, case in self.cases.items():
             TellUser.info(f"Running case {key}...")
+            t_case = time.time()
             scenario = MicrogridScenario(case)
             scenario.optimize_problem_loop(backend=backend,
-                                           solver_opts=solver_opts)
+                                           solver_opts=solver_opts,
+                                           checkpoint_dir=checkpoint_dir)
+            t_solve = time.time()
             results.add_instance(key, scenario)
+            TellUser.debug(f"case {key}: dispatch {t_solve - t_case:.2f}s, "
+                           f"post-processing {time.time() - t_solve:.2f}s")
         results.sensitivity_summary()
         TellUser.info(f"DERVET runtime: {time.time() - self.start_time:.2f} s")
         return results

@@ -139,6 +139,9 @@ class InputRow:
     type: str
     sensitivity: Optional[List[Any]] = None   # parsed list of raw strings
     coupled: Optional[str] = None             # coupling group label
+    # CBA re-pricing ("Evaluation" columns, reference DERVETParams.py:157-467)
+    eval_value: Any = None                    # raw string (scalar or list)
+    eval_active: bool = False
 
 
 def _read_csv_rows(path: Path) -> List[InputRow]:
@@ -166,9 +169,15 @@ def _read_csv_rows(path: Path) -> List[InputRow]:
         coupled = r.get("Coupled")
         coupled = None if (coupled is None or pd.isna(coupled)
                            or str(coupled).strip() in ("None", "")) else str(coupled).strip()
+        eval_active = str(r.get("Evaluation Active", "")).strip().lower() \
+            in ("yes", "y", "1")
+        eval_value = r.get("Evaluation Value")
+        if eval_value is not None and pd.isna(eval_value):
+            eval_value = None
         rows.append(InputRow(tag=tag, id=rid, key=str(key).strip(),
                              value=r[value_col], type=str(r.get("Type", "string")).strip(),
-                             sensitivity=sens, coupled=coupled))
+                             sensitivity=sens, coupled=coupled,
+                             eval_value=eval_value, eval_active=eval_active))
     return [r for r in rows if (r.tag, r.id) in active_pairs]
 
 
@@ -191,10 +200,16 @@ def _read_json_rows(path: Path) -> List[InputRow]:
                                  str(sens.get("value", "")).replace("[", "").replace("]", "").split(",")]
                     coupled = sens.get("coupled")
                     coupled = None if coupled in (None, "None", "") else str(coupled)
+                ev = attrs.get("evaluation", {})
+                eval_active = isinstance(ev, dict) and \
+                    str(ev.get("active", "no")).strip().lower() in ("yes", "y", "1")
                 rows.append(InputRow(tag=tag, id=rid, key=key,
                                      value=attrs.get("opt_value", attrs.get("value")),
                                      type=str(attrs.get("type", SCHEMA.get(tag, {}).get(key, "string"))),
-                                     sensitivity=sens_list, coupled=coupled))
+                                     sensitivity=sens_list, coupled=coupled,
+                                     eval_value=(ev.get("value")
+                                                 if isinstance(ev, dict) else None),
+                                     eval_active=eval_active))
     return rows
 
 
@@ -257,6 +272,8 @@ class CaseParams:
     sensitivity_df: pd.DataFrame = dataclasses.field(default_factory=pd.DataFrame)
     # CBA "Evaluation" re-pricing values keyed like overrides (tag, id, key)
     cba_overrides: Dict[Tuple[str, str, str], Any] = dataclasses.field(default_factory=dict)
+    # root for resolving referenced-data paths (evaluation reloads need it)
+    base_path: Optional[Path] = None
 
 
 class Params:
@@ -332,10 +349,13 @@ class Params:
         for combo in itertools.product(*axes):
             overrides = {}
             rec = {}
+            idx_map = {}
             for grp, j in combo:
                 for r in grp:
                     overrides[(r.tag, r.id, r.key)] = r.sensitivity[j]
+                    idx_map[(r.tag, r.id, r.key)] = j
                     rec[f"{r.tag}/{r.key}"] = r.sensitivity[j]
+            overrides["__sens_idx__"] = idx_map
             case_defs.append(overrides)
             records.append(rec)
         return case_defs, pd.DataFrame(records)
@@ -343,7 +363,34 @@ class Params:
     # ------------------------------------------------------------------
     @classmethod
     def _build_case(cls, case_id, rows, overrides, base, verbose) -> CaseParams:
+        overrides = dict(overrides)
+        sens_idx = overrides.pop("__sens_idx__", {})
         tag_maps: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        cba_overrides: Dict[Tuple[str, str, str], Any] = {}
+        for r in rows:
+            if r.eval_active and r.eval_value is not None:
+                declared = SCHEMA.get(r.tag, {}).get(r.key, r.type or "string")
+                raw_ev = str(r.eval_value)
+                if r.sensitivity:
+                    # evaluation values coupled to a sensitivity sweep must
+                    # supply one value per sensitivity entry (reference:
+                    # test_cba.py test_catch_wrong_length)
+                    parts = [p.strip() for p in
+                             raw_ev.replace("[", "").replace("]", "").split(",")]
+                    if len(parts) != len(r.sensitivity):
+                        raise ModelParameterError(
+                            f"Evaluation list for {r.tag}.{r.key} has "
+                            f"{len(parts)} values but the sensitivity sweep "
+                            f"has {len(r.sensitivity)}")
+                    j = sens_idx.get((r.tag, r.id, r.key), 0)
+                    raw_ev = parts[j]
+                try:
+                    cba_overrides[(r.tag, r.id, r.key)] = convert_value(
+                        raw_ev, declared, key=f"{r.tag}.{r.key}")
+                except (ValueError, TypeError) as e:
+                    raise ModelParameterError(
+                        f"bad Evaluation value {raw_ev!r} for "
+                        f"{r.tag}.{r.key}: {e}")
         for r in rows:
             raw = overrides.get((r.tag, r.id, r.key), r.value)
             declared = SCHEMA.get(r.tag, {}).get(r.key, r.type or "string")
@@ -390,4 +437,5 @@ class Params:
                 normalize_path(rel["load_shed_perc_filename"], base))
         return CaseParams(case_id=case_id, scenario=scenario, finance=finance,
                           results=results, ders=ders, streams=streams,
-                          datasets=datasets, overrides=dict(overrides))
+                          datasets=datasets, overrides=dict(overrides),
+                          cba_overrides=cba_overrides, base_path=base)

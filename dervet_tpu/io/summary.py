@@ -1,0 +1,28 @@
+"""Pre-run input echo (reference: storagevet.Visualization.class_summary,
+invoked from dervet/DERVET.py:68-70 in verbose mode): prints every active
+tag's keys/values so the user can confirm what was loaded."""
+from __future__ import annotations
+
+from typing import Dict
+
+import pandas as pd
+
+from ..utils.errors import TellUser
+
+
+def class_summary(cases: Dict) -> None:
+    first = cases[min(cases.keys())]
+    sections = [("Scenario", first.scenario), ("Finance", first.finance),
+                ("Results", first.results)]
+    sections += [(f"{tag} (id {der_id or '1'})", keys)
+                 for tag, der_id, keys in first.ders]
+    sections += [(tag, keys) for tag, keys in first.streams.items()]
+    lines = ["", "=" * 60, "INPUT SUMMARY", "=" * 60]
+    for title, keys in sections:
+        lines.append(f"--- {title} ---")
+        df = pd.Series({k: v for k, v in sorted(keys.items())}, dtype=object)
+        lines.append(df.to_string())
+    if len(cases) > 1:
+        lines.append(f"--- Sensitivity: {len(cases)} cases ---")
+        lines.append(first.sensitivity_df.to_string())
+    TellUser.info("\n".join(lines))

@@ -135,10 +135,15 @@ class RotatingGenerator(DER):
         g = lambda k: float(self.keys.get(k, 0) or 0)
         return g("rcost") + g("rcost_kW") * self.max_power_out
 
+    #: proforma fuel column suffix (reference test assertions fix
+    #: 'ICE: <name> Diesel Fuel Costs'; generic generators use 'Fuel Costs')
+    fuel_col = "Fuel Costs"
+
     def proforma_report(self, opt_years, apply_inflation_rate_func=None,
                         fill_forward_func=None):
         """Fixed O&M + variable O&M + fuel cost rows (reference:
-        CombustionTurbine.py:122-152 fuel rows; storagevet generator O&M)."""
+        CombustionTurbine.py:122-152 fuel rows; storagevet generator O&M;
+        column names per test_cba.py assertions)."""
         uid = self.unique_tech_id
         rows = {}
         v = self.variables_df
@@ -150,10 +155,10 @@ class RotatingGenerator(DER):
             if v is not None and "elec" in v:
                 mask = v.index.year == yr
                 gen_kwh = self.dt * float(v.loc[mask, "elec"].sum())
-            row[f"{uid} Variable O&M Cost"] = -self.variable_om * gen_kwh
+            row[f"{uid} Variable O&M Costs"] = -self.variable_om * gen_kwh
             fuel = self._yearly_fuel_cost(yr, gen_kwh)
             if fuel is not None:
-                row[f"{uid} Fuel Cost"] = fuel
+                row[f"{uid} {self.fuel_col}"] = fuel
             rows[per] = row
         return pd.DataFrame(rows).T
 
@@ -175,6 +180,8 @@ class RotatingGenerator(DER):
 class ICE(RotatingGenerator):
     """Internal-combustion engine: liquid fuel priced per gallon
     (reference: MicrogridDER/ICE.py:84-95; efficiency in gal/kWh)."""
+
+    fuel_col = "Diesel Fuel Costs"
 
     def __init__(self, keys: Dict, scenario: Dict, der_id: str = "",
                  datasets=None):

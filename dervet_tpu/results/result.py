@@ -12,6 +12,7 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Dict, Optional
 
+import numpy as np
 import pandas as pd
 
 from ..utils.errors import TellUser
@@ -95,17 +96,57 @@ class CaseResult:
             report = getattr(der, "degradation_report", lambda: None)()
             if report is not None:
                 self.drill_down_dict[f"degradation_data_{der.name}"] = report
+        self._dispatch_drill_downs()
+
+    def _dispatch_drill_downs(self) -> None:
+        """Hour x day pivots + peak-day summary (reference output set:
+        peak_day_load / <name>_dispatch_map / energyp_map, SURVEY §2.7)."""
+        ts = self.time_series_data
+        if ts is None or not len(ts):
+            return
+        idx = ts.index
+
+        def pivot(series: pd.Series) -> pd.DataFrame:
+            df = pd.DataFrame({"hour": idx.hour + 1,
+                               "day": idx.normalize().date,
+                               "val": series.to_numpy()})
+            return df.pivot_table(index="hour", columns="day", values="val")
+
+        if "Total Load (kW)" in ts.columns:
+            load = ts["Total Load (kW)"]
+            peak_day = load.groupby(idx.date).max().idxmax()
+            mask = np.asarray(idx.date == peak_day)
+            self.drill_down_dict["peak_day_load"] = pd.DataFrame({
+                "Timestep Beginning": np.arange(int(mask.sum()), dtype=float),
+                "Date": [peak_day] * int(mask.sum()),
+                "Load (kW)": load[mask].to_numpy(),
+                "Net Load (kW)": ts.loc[mask, "Net Load (kW)"].to_numpy(),
+            })
+        s = self.scenario
+        for der in s.ders:
+            if der.technology_type == "Energy Storage System" and \
+                    der.variables_df is not None:
+                # golden es_dispatch_map convention: charging negative
+                self.drill_down_dict[f"{der.name}_dispatch_map"] = \
+                    pivot(der.variables_df["dis"] - der.variables_df["ch"])
+        for col, name in (("Tariff Energy Price ($/kWh)", "energyp_map"),
+                          ("DA Price ($/kWh)", "energyp_map")):
+            if col in ts.columns and name not in self.drill_down_dict:
+                self.drill_down_dict[name] = pivot(ts[col])
 
     def calculate_cba(self) -> None:
         from ..financial.cba import CostBenefitAnalysis
         s = self.scenario
         try:
-            cba = CostBenefitAnalysis(s.case.finance, s.start_year, s.end_year,
+            # "Evaluation" re-pricing: the CBA may value the SAME dispatch
+            # with different financial inputs than the optimization used
+            ders, streams, finance = s.evaluation_clones()
+            cba = CostBenefitAnalysis(finance, s.start_year, s.end_year,
                                       s.opt_years, dt=s.dt)
         except Exception as e:  # financial inputs optional in early slices
             TellUser.warning(f"CBA skipped: {e}")
             return
-        cba.calculate(s.ders, s.streams, self.time_series_data, s.opt_years,
+        cba.calculate(ders, streams, self.time_series_data, s.opt_years,
                       poi=s.poi)
         self.proforma_df = cba.proforma
         self.npv_df = cba.npv

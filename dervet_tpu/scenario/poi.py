@@ -81,8 +81,38 @@ class POI:
             b.add_rows("poi_import", coef_terms, "ge", self.max_import + load)
 
         self._grid_charge_rows(b, ctx)
+        self._thermal_rows(b, ctx)
         self._requirement_rows(b, ctx, requirements)
         self._market_rows(b, ctx)
+
+    def _thermal_rows(self, b: LPBuilder, ctx: WindowContext) -> None:
+        """Steam / hot-water balance: recovered heat must cover the site
+        thermal loads (reference MicrogridPOI.py:215-258; load columns per
+        DERVETParams.py:597-633, a missing component defaults to zero)."""
+        if not self.scenario.get("incl_thermal_load", False):
+            return
+        chps = [d for d in self.active_ders if hasattr(d, "steam_term")]
+        if not chps:
+            if any(hasattr(d, "steam_term") for d in self.der_list):
+                TellUser.warning(
+                    "incl_thermal_load is set but no heat-producing DER is "
+                    "active this window — the site thermal load is unserved")
+            return
+        steam_load = ctx.col("Site Steam Thermal Load (BTU/hr)")
+        hotwater_load = ctx.col("Site Hot Water Thermal Load (BTU/hr)")
+        if steam_load is None and hotwater_load is None:
+            raise ParameterError(
+                "CHP with incl_thermal_load requires 'Site Steam Thermal "
+                "Load (BTU/hr)' and/or 'Site Hot Water Thermal Load "
+                "(BTU/hr)' in the time series")
+        if steam_load is not None:
+            b.add_rows("thermal_steam",
+                       [(d.steam_term(b), 1.0) for d in chps], "ge",
+                       steam_load)
+        if hotwater_load is not None:
+            b.add_rows("thermal_hotwater",
+                       [(d.hotwater_term(b), 1.0) for d in chps], "ge",
+                       hotwater_load)
 
     def _market_rows(self, b: LPBuilder, ctx: WindowContext) -> None:
         """Joint market-service rows: all services share DER headroom, and

@@ -202,8 +202,73 @@ class MicrogridScenario:
                                  ess.user_bounds["ch"][1])
 
     # ------------------------------------------------------------------
+    def _checkpoint_path(self, checkpoint_dir):
+        from pathlib import Path
+        return Path(checkpoint_dir) / f"case{self.case.case_id}_windows.npz"
+
+    def _checkpoint_fingerprint(self) -> str:
+        """Hash of the inputs that determine per-window solutions — a
+        checkpoint from different inputs must be discarded, not resumed."""
+        import hashlib
+        h = hashlib.sha256()
+        h.update(repr((str(self.index[0]), str(self.index[-1]),
+                       len(self.index), self.dt, str(self.n),
+                       self.opt_years)).encode())
+        for tag, der_id, keys in self.case.ders:
+            h.update(repr((tag, der_id, sorted(keys.items()))).encode())
+        for tag, keys in sorted(self.case.streams.items()):
+            h.update(repr((tag, sorted(keys.items()))).encode())
+        ts = self.case.datasets.time_series
+        if ts is not None:
+            h.update(np.ascontiguousarray(
+                ts.to_numpy(dtype=np.float64, na_value=np.nan)).tobytes())
+        return h.hexdigest()
+
+    def _load_checkpoint(self, checkpoint_dir, solution):
+        """Resume per-window results saved by a previous run (SURVEY §5:
+        the reference has no checkpointing; per-window results are cheap to
+        persist and make long sweeps restartable)."""
+        path = self._checkpoint_path(checkpoint_dir)
+        if not path.exists():
+            return set()
+        try:
+            data = np.load(path, allow_pickle=True)
+            if str(data["__fingerprint__"]) != self._checkpoint_fingerprint():
+                TellUser.warning(f"checkpoint {path} was created from "
+                                 "different inputs — ignoring it")
+                return set()
+            labels = set(int(x) for x in data["__labels__"])
+            for name in data.files:
+                if not name.startswith("__"):
+                    solution[name] = data[name]
+            import json
+            self.objective_values.update(
+                {int(k): v for k, v in
+                 json.loads(str(data["__objectives__"])).items()})
+        except Exception as e:    # truncated/corrupt file: start fresh
+            TellUser.warning(f"could not resume checkpoint {path}: {e}")
+            return set()
+        TellUser.info(f"resumed {len(labels)} solved window(s) from {path}")
+        return labels
+
+    def _save_checkpoint(self, checkpoint_dir, solution, solved_labels):
+        import json
+        import os
+        from pathlib import Path
+        Path(checkpoint_dir).mkdir(parents=True, exist_ok=True)
+        path = self._checkpoint_path(checkpoint_dir)
+        tmp = path.with_name(path.stem + "_tmp.npz")
+        np.savez(tmp,
+                 __fingerprint__=self._checkpoint_fingerprint(),
+                 __labels__=np.array(sorted(solved_labels)),
+                 __objectives__=json.dumps(
+                     {str(k): v for k, v in self.objective_values.items()}),
+                 **solution)
+        os.replace(tmp, path)    # atomic: interruption keeps the old file
+
+    # ------------------------------------------------------------------
     def optimize_problem_loop(self, backend: str = "jax",
-                              solver_opts=None) -> None:
+                              solver_opts=None, checkpoint_dir=None) -> None:
         """Group windows by length, batch-solve each group, scatter results."""
         self.sizing_module()
         t0 = time.time()
@@ -225,6 +290,9 @@ class MicrogridScenario:
 
         # per-variable full-horizon arrays, filled window by window
         solution: Dict[str, np.ndarray] = {}
+        solved: set = set()
+        if checkpoint_dir:
+            solved = self._load_checkpoint(checkpoint_dir, solution)
         windows = self.windows
         n_solves = 0
         if self.poi.is_sizing_optimization:
@@ -267,12 +335,19 @@ class MicrogridScenario:
             # state (reference Battery.py:87-110; SURVEY §7 hard part #3) —
             # solve windows sequentially in time order, updating SOH (and
             # therefore the next window's energy bounds) after each
+            ckpt_stride = 8    # full-horizon npz writes are not free
             for ctx in windows:
-                self._solve_subgroup(
-                    [(ctx, self.build_window_lp(ctx, annuity_scalar,
-                                                requirements))],
-                    backend, solver_opts, solution)
-                n_solves += 1
+                if ctx.label not in solved:
+                    self._solve_subgroup(
+                        [(ctx, self.build_window_lp(ctx, annuity_scalar,
+                                                    requirements))],
+                        backend, solver_opts, solution)
+                    n_solves += 1
+                    solved.add(ctx.label)
+                    if checkpoint_dir and (len(solved) % ckpt_stride == 0
+                                           or ctx is windows[-1]):
+                        self._save_checkpoint(checkpoint_dir, solution, solved)
+                # degradation replays from stored profiles on resume
                 pos = np.searchsorted(self.index, ctx.index[0])
                 for d in degrading:
                     arr = solution.get(f"{d.tag}-{d.id or '1'}/ene")
@@ -281,6 +356,9 @@ class MicrogridScenario:
             windows = []
         groups = group_by_length(windows)
         for T, ctxs in sorted(groups.items()):
+            ctxs = [ctx for ctx in ctxs if ctx.label not in solved]
+            if not ctxs:
+                continue
             built = [(ctx, self.build_window_lp(ctx, annuity_scalar, requirements))
                      for ctx in ctxs]
             # sub-group by exact K structure (pattern AND values): only
@@ -295,6 +373,9 @@ class MicrogridScenario:
             for pairs in subgroups.values():
                 self._solve_subgroup(pairs, backend, solver_opts, solution)
                 n_solves += 1
+                solved.update(ctx.label for ctx, _ in pairs)
+                if checkpoint_dir:
+                    self._save_checkpoint(checkpoint_dir, solution, solved)
         self._scatter_to_ders(solution)
         self.solve_metadata.update({
             "backend": backend,
@@ -435,6 +516,81 @@ class MicrogridScenario:
             store = getattr(vs, "store_dispatch", None)
             if store is not None:
                 store(self.index, solution)
+
+    # ------------------------------------------------------------------
+    def evaluation_clones(self):
+        """DER/stream copies re-priced with the case's Evaluation values
+        (reference: CBA deep-copies instances and places evaluation data,
+        CBA.py:235-275).  Dispatch results and frozen sizes carry over; only
+        the financial inputs change."""
+        over = self.case.cba_overrides
+        if not over:
+            return self.ders, self.streams, self.case.finance
+        tech_map = _build_tech_map()
+        vs_map = _build_vs_map()
+        ders = []
+        for der in self.ders:
+            keys = dict(der.keys)
+            touched = False
+            for (t, i, k), v in over.items():
+                if t == der.tag and (i or "") == (der.id or ""):
+                    keys[k] = v
+                    touched = True
+            if not touched:
+                ders.append(der)
+                continue
+            clone = tech_map[der.tag](keys, self.scenario, der.id,
+                                      self.case.datasets)
+            clone.variables_df = der.variables_df
+            for attr in ("ene_max_rated", "ch_max_rated", "dis_max_rated",
+                         "rated_power", "rated_capacity", "soh"):
+                if hasattr(der, attr) and hasattr(clone, attr):
+                    setattr(clone, attr, getattr(der, attr))
+            for flag in ("sizing_ene", "sizing_ch", "sizing_dis"):
+                if hasattr(clone, flag):
+                    setattr(clone, flag, False)
+            ders.append(clone)
+        streams = {}
+        for tag, vs in self.streams.items():
+            keys = dict(vs.keys)
+            touched = False
+            for (t, _, k), v in over.items():
+                if t == tag:
+                    keys[k] = v
+                    touched = True
+            if not touched:
+                streams[tag] = vs
+                continue
+            clone = vs_map[tag](keys, self.scenario, self.case.datasets)
+            if getattr(vs, "dispatch", None) is not None:
+                clone.dispatch = vs.dispatch
+            streams[tag] = clone
+        finance = dict(self.case.finance)
+        for (t, _, k), v in over.items():
+            if t == "Finance":
+                finance[k] = v
+        # filename-type evaluation overrides re-price from DIFFERENT data
+        # files; only the tariff reload is implemented — refuse the rest
+        # loudly rather than silently reusing the optimization data
+        filename_keys = [(t, k) for (t, _, k) in over if k.endswith("_filename")]
+        for t, k in filename_keys:
+            if (t, k) == ("Finance", "customer_tariff_filename"):
+                import dataclasses as _dc
+                from ..io.params import load_tariff, normalize_path
+                datasets = _dc.replace(
+                    self.case.datasets,
+                    tariff=load_tariff(normalize_path(
+                        finance["customer_tariff_filename"],
+                        self.case.base_path)))
+                for tag in ("retailTimeShift", "DCM"):
+                    if tag in streams:
+                        streams[tag] = _build_vs_map()[tag](
+                            streams[tag].keys, self.scenario, datasets)
+            else:
+                raise ParameterError(
+                    f"Evaluation override of {t}.{k} is not supported "
+                    "(only customer_tariff_filename re-pricing)")
+        return ders, streams, finance
 
     # ------------------------------------------------------------------
     def timeseries_results(self) -> pd.DataFrame:

@@ -1,0 +1,61 @@
+"""Auxiliary subsystems: checkpoint/resume, drill-down maps, input echo
+(SURVEY §5: checkpointing is an addition over the reference; drill-down
+CSVs match the reference output set §2.7)."""
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dervet_tpu.api import DERVET
+from dervet_tpu.io.params import Params
+from dervet_tpu.scenario.scenario import MicrogridScenario
+
+REF = Path("/root/reference")
+CASE_000 = REF / "test/test_storagevet_features/model_params/000-DA_battery_month.csv"
+
+
+def test_checkpoint_resume(tmp_path):
+    cases = Params.initialize(CASE_000, base_path=REF)
+    s = MicrogridScenario(cases[0])
+    s.optimize_problem_loop(backend="cpu", checkpoint_dir=tmp_path)
+    full = s.timeseries_results()
+    assert (tmp_path / "case0_windows.npz").exists()
+    n_first = s.solve_metadata["batched_solves"]
+    assert n_first > 0
+
+    # resume: no windows left to solve, identical results
+    cases2 = Params.initialize(CASE_000, base_path=REF)
+    s2 = MicrogridScenario(cases2[0])
+    s2.optimize_problem_loop(backend="cpu", checkpoint_dir=tmp_path)
+    assert s2.solve_metadata["batched_solves"] == 0
+    resumed = s2.timeseries_results()
+    pd.testing.assert_frame_equal(full, resumed)
+    assert set(s2.objective_values) == set(s.objective_values)
+    for k in s.objective_values:
+        assert s2.objective_values[k] == pytest.approx(s.objective_values[k])
+
+
+def test_drill_down_maps():
+    inst = DERVET(CASE_000, base_path=REF).solve(backend="cpu").instances[0]
+    dd = inst.drill_down_dict
+    assert "peak_day_load" in dd
+    pk = dd["peak_day_load"]
+    assert {"Timestep Beginning", "Date", "Load (kW)",
+            "Net Load (kW)"} <= set(pk.columns)
+    maps = [k for k in dd if k.endswith("_dispatch_map")]
+    assert maps
+    dm = dd[maps[0]]
+    assert list(dm.index) == list(range(1, 25))     # hour-ending rows
+    assert "energyp_map" in dd
+
+
+def test_class_summary_echo(caplog):
+    import logging
+    from dervet_tpu.io.summary import class_summary
+    cases = Params.initialize(CASE_000, base_path=REF)
+    with caplog.at_level(logging.INFO, logger="dervet_tpu"):
+        class_summary(cases)
+    joined = " ".join(r.message for r in caplog.records)
+    assert "INPUT SUMMARY" in joined
+    assert "Battery" in joined or "ene_max_rated" in joined

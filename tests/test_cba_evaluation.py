@@ -1,0 +1,78 @@
+"""CBA Evaluation-column machinery (VERDICT r1 component #5).
+
+Spec: reference DERVETParams.py:157-467 + test_cba_validation/test_cba.py —
+the CBA re-prices the SAME dispatch with the Evaluation values; coupled
+sensitivity/evaluation lists must match lengths; mismatches raise
+ModelParameterError.
+"""
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dervet_tpu.api import DERVET
+from dervet_tpu.utils.errors import ModelParameterError
+
+REF = Path("/root/reference")
+DIR = REF / "test/test_cba_validation/model_params"
+
+
+@pytest.fixture(scope="module")
+def zeroed():
+    d = DERVET(DIR / "001-cba_valuation.csv", base_path=REF)
+    return d.solve(backend="cpu").instances[0]
+
+
+class TestEvaluateBatteryICECostsToZero:
+    """Reference TestEvaluateBatteryICECostsToZero: evaluation zeroes every
+    battery and ICE cost in the proforma while dispatch stays priced."""
+
+    def test_battery_capital_cost(self, zeroed):
+        col = [c for c in zeroed.proforma_df.columns
+               if c.startswith("BATTERY:") and "Capital Cost" in c]
+        assert col and np.all(zeroed.proforma_df[col[0]].values == 0)
+
+    def test_battery_oms(self, zeroed):
+        pf = zeroed.proforma_df
+        for pat in ("Variable O&M", "Fixed O&M"):
+            col = [c for c in pf.columns
+                   if c.startswith("BATTERY:") and pat in c]
+            assert col and np.all(pf[col[0]].values == 0), pat
+
+    def test_ice_costs(self, zeroed):
+        pf = zeroed.proforma_df
+        for pat in ("Capital Cost", "Variable O&M Costs", "Fixed O&M",
+                    "Diesel Fuel Costs"):
+            col = [c for c in pf.columns
+                   if c.startswith("ICE:") and pat in c]
+            assert col and np.all(pf[col[0]].values == 0), pat
+
+    def test_dispatch_not_zeroed(self, zeroed):
+        """The optimization itself used the real (nonzero) prices."""
+        s = zeroed.scenario
+        bat = next(d for d in s.ders if d.tag == "Battery")
+        assert bat.get_capex() > 0     # original DER keeps its costs
+
+
+def test_sensitivity_evaluation_runs():
+    d = DERVET(DIR / "003-cba_valuation_sensitivity.csv", base_path=REF)
+    res = d.solve(backend="cpu")
+    assert len(res.instances) > 1
+
+
+@pytest.mark.skip(reason="input references test/datasets/000-011-timeseries_"
+                  "5min_2017.csv, dropped from the reference snapshot "
+                  "(.MISSING_LARGE_BLOBS)")
+def test_coupled_evaluation_runs():
+    d = DERVET(DIR / "004-cba_valuation_coupled_dt.csv", base_path=REF)
+    assert d.solve(backend="cpu").instances
+
+
+def test_catch_wrong_length():
+    with pytest.raises(ModelParameterError):
+        DERVET(DIR / "002-catch_wrong_length.csv", base_path=REF)
+
+
+def test_monthly_evaluation_runs():
+    d = DERVET(DIR / "005-cba_monthly_timseries.csv", base_path=REF)
+    assert d.solve(backend="cpu").instances

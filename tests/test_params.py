@@ -22,6 +22,9 @@ REF = Path("/root/reference")
 # inputs whose referenced data files do not exist anywhere in the snapshot
 # (or that only disabled xtest_ reference tests consume)
 KNOWN_UNLOADABLE = {
+    "002-catch_wrong_length.csv",                # reference expects this to
+                                                 # raise: evaluation list vs
+                                                 # sensitivity length mismatch
     "004-cba_valuation_coupled_dt.csv",          # 000-011-timeseries_5min_2017.csv missing
     "Model_Parameters_Template_DER_PoSD.csv",    # .\Testing\... datasets absent
     "Model_Parameters_Template_DER_PoSD_deferral.csv",

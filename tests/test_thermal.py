@@ -1,0 +1,66 @@
+"""CHP thermal balance at the POI (reference MicrogridPOI.py:215-258 +
+CombinedHeatPower.py:77-107: recovered steam/hot water must cover site
+thermal loads; steam <= max_steam_ratio * hotwater;
+(steam + hotwater) * electric_heat_ratio == elec)."""
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dervet_tpu.io.params import Params
+from dervet_tpu.scenario.scenario import MicrogridScenario
+from dervet_tpu.utils.errors import ParameterError
+
+REF = Path("/root/reference")
+MP = REF / "test/test_storagevet_features/model_params"
+
+
+def _chp_case(steam=True, hotwater=True):
+    cases = Params.initialize(MP / "000-DA_battery_month.csv", base_path=REF)
+    case = cases[0]
+    case.scenario["incl_thermal_load"] = True
+    ts = case.datasets.time_series
+    if steam:
+        ts["Site Steam Thermal Load (BTU/hr)"] = 2e5
+    if hotwater:
+        ts["Site Hot Water Thermal Load (BTU/hr)"] = 1e5
+    case.ders.append(("CHP", "1", {
+        "name": "chp1", "rated_capacity": 500, "n": 1,
+        "electric_heat_ratio": 0.0015, "max_steam_ratio": 10,
+        "heat_rate": 9000, "variable_om_cost": 0.001, "fixed_om_cost": 0,
+        "ccost": 0, "ccost_kW": 1000}))
+    return case
+
+
+def test_chp_covers_thermal_loads():
+    s = MicrogridScenario(_chp_case())
+    s.optimize_problem_loop(backend="cpu")
+    ts = s.timeseries_results()
+    steam = ts["CHP: chp1 Steam Heat Recovered (BTU/hr)"].to_numpy()
+    hot = ts["CHP: chp1 Hot Water Heat Recovered (BTU/hr)"].to_numpy()
+    assert (steam >= 2e5 - 1e-3).all()
+    assert (hot >= 1e5 - 1e-3).all()
+    # heat recovery tied to electric output
+    elec = ts["CHP: chp1 Electric Generation (kW)"].to_numpy()
+    np.testing.assert_allclose((steam + hot) * 0.0015, elec, rtol=1e-5,
+                               atol=1e-3)
+    # steam ratio constraint
+    assert (steam <= 10 * hot + 1e-3).all()
+
+
+def test_chp_missing_thermal_columns_raises():
+    case = _chp_case(steam=False, hotwater=False)
+    s = MicrogridScenario(case)
+    with pytest.raises(ParameterError):
+        s.optimize_problem_loop(backend="cpu")
+
+
+def test_thermal_ignored_without_flag():
+    case = _chp_case()
+    case.scenario["incl_thermal_load"] = False
+    s = MicrogridScenario(case)
+    s.optimize_problem_loop(backend="cpu")
+    ts = s.timeseries_results()
+    # without the balance the CHP has no reason to generate heat
+    assert ts["CHP: chp1 Steam Heat Recovered (BTU/hr)"].sum() < \
+        len(ts) * 2e5
