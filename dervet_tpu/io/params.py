@@ -33,6 +33,13 @@ _TRUE = {"1", "1.0", "yes", "y", "true"}
 _FALSE = {"0", "0.0", "no", "n", "false", "nan", "."}
 
 
+def parse_list_str(s: Any) -> List[str]:
+    """Split a bracketed/comma-separated cell into stripped items (the one
+    list syntax shared by CSV, JSON, and XML inputs)."""
+    return [p.strip() for p in
+            str(s).replace("[", "").replace("]", "").split(",")]
+
+
 def convert_value(raw: Any, declared: str, key: str = "") -> Any:
     """Convert a raw cell (string) according to the schema's declared type."""
     s = str(raw).strip()
@@ -164,8 +171,7 @@ def _read_csv_rows(path: Path) -> List[InputRow]:
         sens_active = str(r.get("Sensitivity Analysis", "")).strip().lower() == "yes"
         sens = None
         if sens_active and not pd.isna(r.get("Sensitivity Parameters")):
-            sens = [p.strip() for p in
-                    str(r["Sensitivity Parameters"]).replace("[", "").replace("]", "").split(",")]
+            sens = parse_list_str(r["Sensitivity Parameters"])
         coupled = r.get("Coupled")
         coupled = None if (coupled is None or pd.isna(coupled)
                            or str(coupled).strip() in ("None", "")) else str(coupled).strip()
@@ -179,6 +185,50 @@ def _read_csv_rows(path: Path) -> List[InputRow]:
                              sensitivity=sens, coupled=coupled,
                              eval_value=eval_value, eval_active=eval_active))
     return [r for r in rows if (r.tag, r.id) in active_pairs]
+
+
+def _read_xml_rows(path: Path) -> List[InputRow]:
+    """Read the reference's XML model-parameters format (reference:
+    storagevet Params xmlTree surface, exercised at DERVETParams.py:200-260:
+    tag elements carry active/id attributes; each key child holds Value/
+    Optimization_Value, Type, an `analysis` attribute for sensitivity,
+    Sensitivity_Parameters, Coupled, and an optional Evaluation child)."""
+    import xml.etree.ElementTree as ET
+    tree = ET.parse(path)
+    rows: List[InputRow] = []
+    for tag in tree.getroot():
+        active = (tag.get("active") or "no")[0].lower()
+        if active not in ("y", "1"):
+            continue
+        rid = tag.get("id") or ""
+        rid = "" if rid in (".", "None") else rid
+        for key in tag:
+            val_el = key.find("Optimization_Value")
+            if val_el is None:
+                val_el = key.find("Value")
+            type_el = key.find("Type")
+            sens = None
+            coupled = None
+            analysis = (key.get("analysis") or "no")[0].lower()
+            if analysis in ("y", "1"):
+                sp = key.find("Sensitivity_Parameters")
+                if sp is not None and sp.text:
+                    sens = parse_list_str(sp.text)
+                cp = key.find("Coupled")
+                coupled = cp.text.strip() if cp is not None and cp.text and \
+                    cp.text.strip() not in ("None", "") else None
+            ev = key.find("Evaluation")
+            eval_active = ev is not None and \
+                (ev.get("active") or "no")[0].lower() in ("y", "1")
+            rows.append(InputRow(
+                tag=tag.tag, id=rid, key=key.tag,
+                value=val_el.text if val_el is not None else None,
+                type=(type_el.text if type_el is not None and type_el.text
+                      else SCHEMA.get(tag.tag, {}).get(key.tag, "string")),
+                sensitivity=sens, coupled=coupled,
+                eval_value=ev.text if eval_active else None,
+                eval_active=eval_active))
+    return rows
 
 
 def _read_json_rows(path: Path) -> List[InputRow]:
@@ -196,8 +246,7 @@ def _read_json_rows(path: Path) -> List[InputRow]:
                 sens_list = None
                 coupled = None
                 if isinstance(sens, dict) and str(sens.get("active", "no")).lower() == "yes":
-                    sens_list = [p.strip() for p in
-                                 str(sens.get("value", "")).replace("[", "").replace("]", "").split(",")]
+                    sens_list = parse_list_str(sens.get("value", ""))
                     coupled = sens.get("coupled")
                     coupled = None if coupled in (None, "None", "") else str(coupled)
                 ev = attrs.get("evaluation", {})
@@ -292,6 +341,8 @@ class Params:
         base = Path(base_path) if base_path else path.parent
         if path.suffix.lower() == ".json":
             rows = _read_json_rows(path)
+        elif path.suffix.lower() == ".xml":
+            rows = _read_xml_rows(path)
         else:
             rows = _read_csv_rows(path)
         if not rows:
@@ -375,8 +426,7 @@ class Params:
                     # evaluation values coupled to a sensitivity sweep must
                     # supply one value per sensitivity entry (reference:
                     # test_cba.py test_catch_wrong_length)
-                    parts = [p.strip() for p in
-                             raw_ev.replace("[", "").replace("]", "").split(",")]
+                    parts = parse_list_str(raw_ev)
                     if len(parts) != len(r.sensitivity):
                         raise ModelParameterError(
                             f"Evaluation list for {r.tag}.{r.key} has "

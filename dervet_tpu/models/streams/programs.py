@@ -180,6 +180,11 @@ class Deferral(ValueStream):
         fleet's capability (reference: Deferral.deferral_df consumed at
         MicrogridServiceAggregator.py:93-98)."""
         ts = self.datasets.time_series
+        # anchor the growth projection on the BASE optimized year only —
+        # later (possibly growth-synthesized) years would double-count the
+        # fill's growth
+        base_mask = ts.index.year == min(opt_years)
+        ts = ts[base_mask] if base_mask.any() else ts
         index = ts.index
         dload = np.asarray(grab_column(ts, self.LOAD_COL))
         dt = float(self.scenario.get("dt", 1))

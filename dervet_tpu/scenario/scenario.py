@@ -88,6 +88,16 @@ class MicrogridScenario:
         ts = case.datasets.time_series
         if ts is None:
             raise TimeseriesDataError("a time_series_filename is required")
+        # growth-fill optimization years the data lacks, then drop extras
+        # (reference Library.fill_extra_data/drop_extra_data surface)
+        from ..io.growth import (column_growth_rates, fill_extra_data,
+                                 fill_extra_monthly)
+        rates = column_growth_rates(self.scenario, case.streams, ts.columns)
+        ts = fill_extra_data(ts, self.opt_years, rates)
+        case.datasets.time_series = ts
+        if case.datasets.monthly is not None:
+            case.datasets.monthly = fill_extra_monthly(
+                case.datasets.monthly, self.opt_years)
         keep = ts.index.year.isin(self.opt_years)
         ts = ts.loc[keep]
         if not len(ts):

@@ -1,0 +1,93 @@
+"""Growth fill/drop of referenced data + XML model-parameters input
+(reference: storagevet Library.fill_extra_data/drop_extra_data surface and
+the Params XML tree, SURVEY §2.8)."""
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dervet_tpu.io.growth import (column_growth_rates, fill_extra_data,
+                                  fill_extra_monthly)
+from dervet_tpu.io.params import Params
+
+REF = Path("/root/reference")
+
+
+def test_fill_extra_data_growth_rates():
+    idx = pd.date_range("2017-01-01", periods=8760, freq="h")
+    ts = pd.DataFrame({"Site Load (kW)": 100.0,
+                       "DA Price ($/kWh)": 0.05,
+                       "PV Gen (kW/rated kW)": 0.5}, index=idx)
+    rates = column_growth_rates({"def_growth": 10}, {"DA": {"growth": 5}},
+                                ts.columns)
+    assert rates["Site Load (kW)"] == pytest.approx(0.10)
+    assert rates["DA Price ($/kWh)"] == pytest.approx(0.05)
+    assert rates["PV Gen (kW/rated kW)"] == 0.0
+    out = fill_extra_data(ts, [2017, 2019], rates)
+    y19 = out[out.index.year == 2019]
+    assert len(y19) == 8760
+    assert y19["Site Load (kW)"].iloc[0] == pytest.approx(100 * 1.1 ** 2)
+    assert y19["DA Price ($/kWh)"].iloc[0] == pytest.approx(0.05 * 1.05 ** 2)
+    assert y19["PV Gen (kW/rated kW)"].iloc[0] == pytest.approx(0.5)
+
+
+def test_fill_from_leap_year_drops_feb29():
+    idx = pd.date_range("2020-01-01", periods=8784, freq="h")   # leap
+    ts = pd.DataFrame({"Site Load (kW)": 1.0}, index=idx)
+    out = fill_extra_data(ts, [2021], {"Site Load (kW)": 0.0})
+    y21 = out[out.index.year == 2021]
+    assert len(y21) == 8760
+
+
+def test_fill_extra_monthly():
+    m = pd.DataFrame({"Backup Energy (kWh)": range(12)},
+                     index=pd.MultiIndex.from_tuples(
+                         [(2017, i) for i in range(1, 13)],
+                         names=["Year", "Month"]))
+    out = fill_extra_monthly(m, [2017, 2019])
+    assert (2019, 6) in out.index
+    assert out.loc[(2019, 6), "Backup Energy (kWh)"] == \
+        out.loc[(2017, 6), "Backup Energy (kWh)"]
+
+
+def test_xml_input_round_trip(tmp_path):
+    """A minimal XML model-parameters file loads through the same pipeline
+    as CSV (reference XML surface, DERVETParams.py:200-260)."""
+    ts_path = REF / "data/hourly_timeseries.csv"
+    xml = f"""<input>
+  <Scenario active="yes" id=".">
+    <time_series_filename analysis="no"><Value>{ts_path}</Value><Type>string</Type></time_series_filename>
+    <dt analysis="no"><Value>1</Value><Type>float</Type></dt>
+    <opt_years analysis="no"><Value>2017</Value><Type>list/int</Type></opt_years>
+    <start_year analysis="no"><Value>2017</Value><Type>Period</Type></start_year>
+    <end_year analysis="no"><Value>2020</Value><Type>Period</Type></end_year>
+    <n analysis="no"><Value>month</Value><Type>string</Type></n>
+    <incl_site_load analysis="no"><Value>1</Value><Type>bool</Type></incl_site_load>
+  </Scenario>
+  <Finance active="yes" id=".">
+    <npv_discount_rate analysis="no"><Value>7</Value><Type>float</Type></npv_discount_rate>
+    <inflation_rate analysis="no"><Value>3</Value><Type>float</Type></inflation_rate>
+  </Finance>
+  <Battery active="yes" id="1">
+    <name analysis="no"><Value>xbat</Value><Type>string</Type></name>
+    <ene_max_rated analysis="no"><Value>2000</Value><Type>float</Type></ene_max_rated>
+    <ch_max_rated analysis="no"><Value>1000</Value><Type>float</Type></ch_max_rated>
+    <dis_max_rated analysis="no"><Value>1000</Value><Type>float</Type></dis_max_rated>
+    <rte analysis="no"><Value>85</Value><Type>float</Type></rte>
+    <ccost_kwh analysis="no"><Value>100</Value><Type>float</Type>
+      <Evaluation active="yes">0</Evaluation></ccost_kwh>
+  </Battery>
+  <DA active="yes" id=".">
+    <growth analysis="no"><Value>0</Value><Type>float</Type></growth>
+  </DA>
+</input>"""
+    p = tmp_path / "case.xml"
+    p.write_text(xml)
+    cases = Params.initialize(p, base_path=REF)
+    case = cases[0]
+    assert case.scenario["dt"] == 1.0
+    bat = next(keys for tag, _, keys in case.ders if tag == "Battery")
+    assert bat["ene_max_rated"] == 2000.0
+    assert case.cba_overrides[("Battery", "1", "ccost_kwh")] == 0.0
+    assert "DA" in case.streams

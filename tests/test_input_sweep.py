@@ -1,0 +1,56 @@
+"""End-to-end smoke sweep over the reference's storagevet-features inputs
+(SURVEY §4: the reference's dominant test pattern is input-permutation
+coverage through the full pipeline).  Inputs whose referenced datasets were
+dropped from the snapshot, or that the reference expects to FAIL, are
+declared as such.
+"""
+from pathlib import Path
+
+import pytest
+
+from dervet_tpu.api import DERVET
+from dervet_tpu.utils.errors import (ModelParameterError, ParameterError,
+                                     TimeseriesDataError)
+
+REF = Path("/root/reference")
+MP = REF / "test/test_storagevet_features/model_params"
+
+# inputs whose referenced data files are absent from the snapshot
+MISSING_DATA = {
+    "017-bat_timeseries_dt_sensitivity_couples.csv",   # .xlsx dataset
+    "018-DA_battery_month_5min.csv",                   # 5-min CSV dropped
+    "020-coupled_dt_timseries_error.csv",              # 5-min CSV dropped
+    "021-DR_program_end_nan.csv",                      # 5-min CSV dropped?
+    "022-DR_length_nan.csv",
+    "023-DR_weekends.csv",
+    "026-DA_FR_sensitivity_analysis.csv",
+}
+# inputs the REFERENCE expects to error (error-path fixtures)
+EXPECT_ERROR = {
+    "024-DR_nan_length_prgramd_end_hour.csv": ParameterError,
+}
+
+
+def all_csvs():
+    return sorted(p.name for p in MP.glob("*.csv"))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", all_csvs())
+def test_input_runs_end_to_end(name):
+    path = MP / name
+    if name in EXPECT_ERROR:
+        with pytest.raises(EXPECT_ERROR[name]):
+            DERVET(path, base_path=REF).solve(backend="cpu")
+        return
+    try:
+        res = DERVET(path, base_path=REF).solve(backend="cpu")
+    except (ModelParameterError, TimeseriesDataError) as e:
+        # only the curated allowlist may skip — a path-resolution
+        # regression must fail the sweep, not silently skip it
+        if name in MISSING_DATA:
+            pytest.skip(f"referenced data missing from snapshot: {e}")
+        raise
+    inst = res.instances[0]
+    assert inst.time_series_data is not None
+    assert len(inst.time_series_data)
