@@ -94,20 +94,21 @@ class CostBenefitAnalysis:
     # ------------------------------------------------------------------
     def find_end_year(self, der_list) -> int:
         """Analysis-horizon modes (reference CBA.py:94-130): 1 = user,
-        2 = shortest DER lifetime, 3 = longest DER lifetime."""
+        2 = start year + shortest DER lifetime - 1, 3 = longest.  Sizing +
+        mode 2/3 is an input error (the lifetime is not yet known)."""
         if self.analysis_horizon_mode == 1:
             return self.end_year
-        lifetimes = []
-        for der in der_list:
-            lt = int(der.keys.get("expected_lifetime", 0) or 0)
-            op = int(der.keys.get("operation_year", self.start_year)
-                     or self.start_year)
-            if lt:
-                lifetimes.append(op + lt - 1)
+        if any(d.being_sized() for d in der_list):
+            raise ParameterError(
+                "analysis_horizon_mode 2/3 cannot be combined with sizing "
+                "(reference: CBA.find_end_year + MicrogridScenario.py:142-146)")
+        lifetimes = [d.expected_lifetime for d in der_list
+                     if d.expected_lifetime and d.technology_type != "Load"]
         if not lifetimes:
             return self.end_year
-        return (min(lifetimes) if self.analysis_horizon_mode == 2
-                else max(lifetimes))
+        lt = min(lifetimes) if self.analysis_horizon_mode == 2 \
+            else max(lifetimes)
+        return self.start_year + lt - 1
 
     def annuity_scalar(self, opt_years: List[int]) -> float:
         """Scalar converting one optimized year's cost to lifetime present

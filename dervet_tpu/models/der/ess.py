@@ -117,6 +117,7 @@ class EnergyStorage(DER):
         ene = b.var(self.vname("ene"), T, lb=e_min, ub=e_max)
         ch = b.var(self.vname("ch"), T, lb=0.0, ub=self.charge_capacity())
         dis = b.var(self.vname("dis"), T, lb=0.0, ub=self.discharge_capacity())
+        self._ts_limit_bounds(b, ctx, ene, ch, dis, e_min, e_max)
 
         # SOE evolution: ene[t]*(1+sdr) - ene[t-1] - rte*dt*ch[t] + dt*dis[t] = 0
         # with ene[-1] := e0 (window-entry SOE).  Sparse bidiagonal on ene.
@@ -157,6 +158,11 @@ class EnergyStorage(DER):
                    ub=np.inf if self.sizing_ch else self.charge_capacity())
         dis = b.var(self.vname("dis"), T, lb=0.0,
                     ub=np.inf if self.sizing_dis else self.discharge_capacity())
+        # ts limits still apply to non-sized ratings; the sized rating's
+        # limits log an error and are dropped (reference ESSSizing.py:88-116)
+        self._ts_limit_bounds(b, ctx, ene, ch, dis,
+                              self.operational_min_energy(),
+                              self.operational_max_energy())
 
         if self.sizing_ene:
             size_e = self._size_var(b, "ene")
@@ -271,6 +277,46 @@ class EnergyStorage(DER):
         TellUser.info(f"{self.name} sized: {self.ene_max_rated:.1f} kWh, "
                       f"ch {self.ch_max_rated:.1f} kW / "
                       f"dis {self.dis_max_rated:.1f} kW")
+
+    def _ts_limit_bounds(self, b: LPBuilder, ctx: WindowContext, ene, ch,
+                         dis, e_min: float, e_max: float) -> None:
+        """Optional per-DER time-series limit columns tighten the variable
+        bounds (reference ESSSizing.py:236-262: 'Battery: Charge Max
+        (kW)/<id>' etc., gated by incl_ts_*_limits keys; ignored with an
+        error log when the corresponding rating is being sized)."""
+        tag = self.tag
+        spec = [
+            ("incl_ts_charge_limits", ch,
+             f"{tag}: Charge Min (kW)", f"{tag}: Charge Max (kW)",
+             0.0, self.charge_capacity(), self.sizing_ch),
+            ("incl_ts_discharge_limits", dis,
+             f"{tag}: Discharge Min (kW)", f"{tag}: Discharge Max (kW)",
+             0.0, self.discharge_capacity(), self.sizing_dis),
+            ("incl_ts_energy_limits", ene,
+             f"{tag}: Energy Min (kWh)", f"{tag}: Energy Max (kWh)",
+             e_min, e_max, self.sizing_ene),
+        ]
+        for key, ref, lo_col, hi_col, lo_def, hi_def, sizing in spec:
+            if not self.keys.get(key, False):
+                continue
+            if sizing:
+                TellUser.error(f"{self.name}: ignoring {key} time series "
+                               "because the rating is being sized "
+                               "(reference behavior)")
+                continue
+            lo = ctx.col(lo_col, self.id)
+            hi = ctx.col(hi_col, self.id)
+            if lo is None and hi is None:
+                # the reference records a fatal input error here
+                # (DERVETParams.load_ts_limits, :655-659)
+                raise ParameterError(
+                    f"{self.name}: {key} is set but neither {lo_col!r} nor "
+                    f"{hi_col!r} is in the time series")
+            lo_arr = np.clip(np.nan_to_num(lo, nan=lo_def), lo_def, None) \
+                if lo is not None else lo_def
+            hi_arr = np.clip(np.nan_to_num(hi, nan=hi_def), None, hi_def) \
+                if hi is not None else hi_def
+            b.set_bounds(ref, lb=lo_arr, ub=hi_arr)
 
     def _daily_sum_matrix(self, ctx: WindowContext) -> sp.csr_matrix:
         """(n_days, T) matrix summing dis*dt per calendar day."""

@@ -131,6 +131,19 @@ class MicrogridScenario:
                 raise ParameterError(f"unknown value stream tag {tag!r}")
             self.streams[tag] = cls(keys, self.scenario, case.datasets)
 
+        # analysis-horizon modes 2/3 derive the end year from the shortest/
+        # longest DER lifetime (reference initialize_cba ->
+        # CBA.find_end_year, MicrogridScenario.py:131-156 / CBA.py:94-130);
+        # find_end_year is mode-aware and a no-op for mode 1
+        from ..financial.cba import CostBenefitAnalysis
+        cba = CostBenefitAnalysis(case.finance, self.start_year,
+                                  self.end_year, self.opt_years, self.dt)
+        new_end = cba.find_end_year(self.ders)
+        if new_end != self.end_year:
+            TellUser.info(f"analysis_horizon_mode "
+                          f"{cba.analysis_horizon_mode}: end year "
+                          f"{self.end_year} -> {new_end}")
+            self.end_year = new_end
         # lifecycle horizon must be known BEFORE dispatch so that
         # grab_active_ders can drop equipment past its end of life
         for der in self.ders:

@@ -82,6 +82,22 @@ def test_ecc_substitution():
     assert cc[2020] != 0 and cc[2021] == 0
 
 
+def test_analysis_horizon_modes():
+    """Mode 2 = shortest DER lifetime, mode 3 = longest (reference
+    CBA.py:94-130 wired through scenario init)."""
+    from dervet_tpu.io.params import Params
+    from dervet_tpu.scenario.scenario import MicrogridScenario
+    MP = REF / "test/test_storagevet_features/model_params"
+    cases = Params.initialize(MP / "000-DA_battery_month.csv", base_path=REF)
+    case = cases[0]
+    for tag, _, keys in case.ders:
+        keys["operation_year"] = 2017
+        keys["expected_lifetime"] = 6
+    case.finance["analysis_horizon_mode"] = 2
+    s = MicrogridScenario(case)
+    assert s.end_year == 2022
+
+
 def test_equipment_lifetimes_saved(tmp_path):
     d = DERVET(UC1 / "Model_Parameters_Template_Usecase1_UnPlanned_ES.csv",
                base_path=REF)
