@@ -96,19 +96,27 @@ class CostBenefitAnalysis:
         """Analysis-horizon modes (reference CBA.py:94-130): 1 = user,
         2 = start year + shortest DER lifetime - 1, 3 = longest.  Sizing +
         mode 2/3 is an input error (the lifetime is not yet known)."""
-        if self.analysis_horizon_mode == 1:
+        if self.analysis_horizon_mode not in (2, 3):
+            # unrecognized modes keep the user-supplied end year (reference
+            # falls through unchanged)
             return self.end_year
         if any(d.being_sized() for d in der_list):
             raise ParameterError(
                 "analysis_horizon_mode 2/3 cannot be combined with sizing "
                 "(reference: CBA.find_end_year + MicrogridScenario.py:142-146)")
-        lifetimes = [d.expected_lifetime for d in der_list
-                     if d.expected_lifetime and d.technology_type != "Load"]
+        if self.analysis_horizon_mode == 2:
+            # shortest lifetime over ALL DERs (loads included)
+            lifetimes = [d.expected_lifetime for d in der_list
+                         if d.expected_lifetime]
+            agg = min
+        else:
+            # longest lifetime excluding loads (reference CBA.py:108-118)
+            lifetimes = [d.expected_lifetime for d in der_list
+                         if d.expected_lifetime and d.technology_type != "Load"]
+            agg = max
         if not lifetimes:
             return self.end_year
-        lt = min(lifetimes) if self.analysis_horizon_mode == 2 \
-            else max(lifetimes)
-        return self.start_year + lt - 1
+        return self.start_year + agg(lifetimes) - 1
 
     def annuity_scalar(self, opt_years: List[int]) -> float:
         """Scalar converting one optimized year's cost to lifetime present

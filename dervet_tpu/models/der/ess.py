@@ -158,12 +158,6 @@ class EnergyStorage(DER):
                    ub=np.inf if self.sizing_ch else self.charge_capacity())
         dis = b.var(self.vname("dis"), T, lb=0.0,
                     ub=np.inf if self.sizing_dis else self.discharge_capacity())
-        # ts limits still apply to non-sized ratings; the sized rating's
-        # limits log an error and are dropped (reference ESSSizing.py:88-116)
-        self._ts_limit_bounds(b, ctx, ene, ch, dis,
-                              self.operational_min_energy(),
-                              self.operational_max_energy())
-
         if self.sizing_ene:
             size_e = self._size_var(b, "ene")
             b.add_rows(self.vname("ene_ub"),
@@ -203,6 +197,13 @@ class EnergyStorage(DER):
             b.add_rows(self.vname("dis_ub"), [(dis, 1.0), (size_d, -one)],
                        "le", 0.0)
             b.add_cost(size_d, self.ccost_kw, label=f"{self.name}capex")
+        # ts limits still apply to non-sized ratings; the sized rating's
+        # limits log an error and are dropped (reference ESSSizing.py:88-116).
+        # Applied AFTER the static bound assignments above so per-timestep
+        # limits are not overwritten.
+        self._ts_limit_bounds(b, ctx, ene, ch, dis,
+                              self.operational_min_energy(),
+                              self.operational_max_energy())
         if self.ccost:
             b.add_const_cost(self.ccost, label=f"{self.name}capex")
         if self.duration_max and self.sizing_ene and self.sizing_dis:

@@ -136,14 +136,15 @@ class MicrogridScenario:
         # CBA.find_end_year, MicrogridScenario.py:131-156 / CBA.py:94-130);
         # find_end_year is mode-aware and a no-op for mode 1
         from ..financial.cba import CostBenefitAnalysis
-        cba = CostBenefitAnalysis(case.finance, self.start_year,
-                                  self.end_year, self.opt_years, self.dt)
-        new_end = cba.find_end_year(self.ders)
+        self.cba = CostBenefitAnalysis(case.finance, self.start_year,
+                                       self.end_year, self.opt_years, self.dt)
+        new_end = self.cba.find_end_year(self.ders)
         if new_end != self.end_year:
             TellUser.info(f"analysis_horizon_mode "
-                          f"{cba.analysis_horizon_mode}: end year "
+                          f"{self.cba.analysis_horizon_mode}: end year "
                           f"{self.end_year} -> {new_end}")
             self.end_year = new_end
+            self.cba.end_year = new_end
         # lifecycle horizon must be known BEFORE dispatch so that
         # grab_active_ders can drop equipment past its end of life
         for der in self.ders:
@@ -303,10 +304,7 @@ class MicrogridScenario:
         annuity_scalar = 1.0
         if self.poi.is_sizing_optimization:
             self.check_opt_sizing_conditions()
-            from ..financial.cba import CostBenefitAnalysis
-            cba = CostBenefitAnalysis(self.case.finance, self.start_year,
-                                      self.end_year, self.opt_years, self.dt)
-            annuity_scalar = cba.annuity_scalar(self.opt_years)
+            annuity_scalar = self.cba.annuity_scalar(self.opt_years)
             self.solve_metadata["annuity_scalar"] = annuity_scalar
         if not self.opt_engine:
             return

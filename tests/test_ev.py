@@ -1,0 +1,57 @@
+"""Electric-vehicle DERs (reference MicrogridDER/ElectricVehicles.py:
+EV1 plug-window session charging to ene_target :194-297; EV2 baseline
+load control between (1-ctrl)*baseline and baseline :495-544)."""
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dervet_tpu.io.params import Params
+from dervet_tpu.scenario.scenario import MicrogridScenario
+
+REF = Path("/root/reference")
+MP = REF / "test/test_storagevet_features/model_params"
+
+
+def _case_with(der_tag, keys):
+    cases = Params.initialize(MP / "000-DA_battery_month.csv", base_path=REF)
+    case = cases[0]
+    case.ders.append((der_tag, "1", keys))
+    return case
+
+
+def test_ev1_sessions_reach_target():
+    case = _case_with("ElectricVehicle1", {
+        "name": "ev1", "ch_max_rated": 50, "ch_min_rated": 0,
+        "ene_target": 80, "plugin_time": 19, "plugout_time": 7})
+    s = MicrogridScenario(case)
+    s.optimize_problem_loop(backend="cpu")
+    ts = s.timeseries_results()
+    ch = ts["ELECTRICVEHICLE1: ev1 Charge (kW)"]
+    hours = ch.index.hour
+    plugged = (hours >= 19) | (hours < 7)
+    # never charges unplugged
+    assert (ch[~plugged] <= 1e-6).all()
+    # overnight sessions fully inside a window deliver the target energy
+    session_sums = ch.groupby((plugged != np.roll(plugged, 1)).cumsum()).sum()
+    full_sessions = session_sums[(session_sums > 1.0)]
+    assert len(full_sessions) > 300
+    med = float(np.median(full_sessions))
+    assert med == pytest.approx(80.0, rel=1e-4)
+
+
+def test_ev2_baseline_bounds():
+    case = _case_with("ElectricVehicle2", {
+        "name": "fleet", "max_load_ctrl": 40, "lost_load_cost": 10000})
+    rng = np.random.default_rng(3)
+    case.datasets.time_series["EV fleet/1"] = rng.uniform(
+        10, 60, len(case.datasets.time_series))
+    s = MicrogridScenario(case)
+    s.optimize_problem_loop(backend="cpu")
+    ts = s.timeseries_results()
+    ch = ts["ELECTRICVEHICLE2: fleet Charge (kW)"].to_numpy()
+    from dervet_tpu.scenario.window import grab_column
+    base = grab_column(case.datasets.time_series.loc[ts.index],
+                       "EV fleet", "1")
+    assert (ch <= base + 1e-6).all()
+    assert (ch >= 0.6 * base - 1e-6).all()

@@ -64,6 +64,22 @@ class TestUsecase2ReliabilitySizing:
         assert "load_coverage_prob" in case.drill_down_dict
 
 
+class TestUsecase2EsPvSizing:
+    """ESS sized for reliability with fixed PV — unplanned outage."""
+
+    @pytest.fixture(scope="class")
+    def case(self):
+        d = DERVET(
+            UC2 / "Model_Parameters_Template_Usecase3_UnPlanned_ES+PV.csv",
+            base_path=REF)
+        return d.solve(backend="cpu").instances[0]
+
+    def test_size_within_bound(self, case):
+        compare_size_results(case,
+                             RES2 / "es+pv/sizeuc3_es+pv_step1.csv",
+                             MAX_PERCENT_ERROR)
+
+
 class TestUsecase2EsPvDgSizing:
     """ESS+PV+DG sized for reliability — unplanned outage (reference:
     Usecase2 es+pv+dg step1)."""
