@@ -52,28 +52,37 @@ def rolling_forward_sum(arr: np.ndarray, window: int) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnames=("L",))
-def _simulate_all_outages(reliability_check: jax.Array, demand_left: jax.Array,
-                          energy_check: jax.Array, init_soe: jax.Array,
+def _simulate_all_outages(crit: jax.Array, gen: jax.Array, pv_max: jax.Array,
+                          pv_vari: jax.Array, gamma: float, shed: jax.Array,
+                          init_soe: jax.Array,
                           ch_max: float, dis_max: float, e_min: float,
                           e_max: float, rte: float, dt: float, L: int):
     """Greedy SOE walk for an outage starting at every timestep.
 
-    Inputs are full-horizon (T,) arrays; returns ``(coverage, profiles)``
-    where ``coverage[i]`` counts survived steps (capped by horizon end) and
-    ``profiles[i, j]`` is the SOE after step j of the outage starting at i
-    (0 once dead).  Mirrors reference Reliability.py:489-570.
+    Inputs are full-horizon (T,) arrays plus a per-OUTAGE-STEP load-shed
+    factor ``shed`` of length L (fraction of critical load that must be
+    served at outage hour j — reference data_process applies the shed
+    curve by outage step, Reliability.py:471-485).  Returns ``(coverage,
+    profiles)`` where ``coverage[i]`` counts survived steps (capped by
+    horizon end) and ``profiles[i, j]`` is the SOE after step j of the
+    outage starting at i (0 once dead).  Mirrors Reliability.py:489-570
+    incl. the 5-decimal data rounding and 2-decimal feasibility checks.
     """
-    T = reliability_check.shape[0]
+    T = crit.shape[0]
     starts = jnp.arange(T)
+
+    def _round5(x):
+        return jnp.round(x * 1e5) / 1e5
 
     def step(carry, j):
         soe, alive = carry
         idx = starts + j
         in_range = idx < T
         idxc = jnp.minimum(idx, T - 1)
-        rc = reliability_check[idxc]
-        dl = demand_left[idxc]
-        ec = energy_check[idxc]
+        load = crit[idxc] * shed[j]
+        rc = _round5(load - gen[idxc] - pv_vari[idxc])
+        dl = _round5(load - gen[idxc] - pv_max[idxc])
+        ec = rc * gamma
 
         # surplus branch: generation covers the load; charge what fits
         can_store = e_max >= soe
@@ -191,26 +200,24 @@ class Reliability(ValueStream):
         return {"props": props, "gen": gen, "pv_max": pv_max,
                 "pv_vari": pv_vari, "gamma": largest_gamma}
 
-    def _checks(self, mix) -> tuple:
-        """Full-horizon reliability/demand/energy check arrays (reference
-        ``data_process`` rounding semantics, Reliability.py:448-487).  The
-        load-shed percentage applies by outage STEP, not timestep, so it
-        enters inside the walk only when shedding is flat; for per-step
-        shed curves we conservatively apply step-0 (=100%) here and the
-        shaped curve in the sizing LP."""
-        crit = self.critical_load.to_numpy()
+    def _shed_curve(self, L: int) -> np.ndarray:
+        """Per-outage-step fraction of critical load to serve (reference:
+        load_shed_data applies by outage step, Reliability.py:471-485)."""
+        shed = np.ones(L)
         if self.load_shed and self.load_shed_data is not None:
-            crit = crit * (self.load_shed_data[0] / 100.0)
-        demand_left = np.around(crit - mix["gen"] - mix["pv_max"], 5)
-        reliability_check = np.around(crit - mix["gen"] - mix["pv_vari"], 5)
-        energy_check = reliability_check * mix["gamma"]
-        return reliability_check, demand_left, energy_check
+            k = min(L, len(self.load_shed_data))
+            shed[:k] = self.load_shed_data[:k] / 100.0
+            if k < L:
+                shed[k:] = self.load_shed_data[-1] / 100.0
+        return shed
 
     def _walk(self, mix, init_soe: np.ndarray, L: int):
-        rc, dl, ec = self._checks(mix)
         p = mix["props"]
         cov, prof = _simulate_all_outages(
-            jnp.asarray(rc), jnp.asarray(dl), jnp.asarray(ec),
+            jnp.asarray(self.critical_load.to_numpy()),
+            jnp.asarray(mix["gen"]), jnp.asarray(mix["pv_max"]),
+            jnp.asarray(mix["pv_vari"]), mix["gamma"],
+            jnp.asarray(self._shed_curve(L)),
             jnp.asarray(init_soe, jnp.float64 if jax.config.jax_enable_x64
                         else jnp.float32),
             p["charge max"], p["discharge max"], p["soe min"], p["soe max"],
@@ -328,10 +335,7 @@ class Reliability(ValueStream):
             Lk = int(min(L, T - s0))
             if Lk <= 0:
                 continue
-            crit = crit_full[s0:s0 + Lk].copy()
-            if self.load_shed and self.load_shed_data is not None:
-                shed = self.load_shed_data[:Lk]
-                crit[:len(shed)] = crit[:len(shed)] * shed / 100.0
+            crit = crit_full[s0:s0 + Lk] * self._shed_curve(Lk)
             balance = []          # terms summing to supply (kW)
             const_supply = np.zeros(Lk)
             for e in ess:

@@ -60,8 +60,14 @@ class TestUsecase2ReliabilitySizing:
         compare_size_results(case, RES2 / "es/step1/sizeuc3_es_step1.csv",
                              MAX_PERCENT_ERROR)
 
-    def test_lcpc_exists(self, case):
-        assert "load_coverage_prob" in case.drill_down_dict
+    def test_lcpc_within_bound(self, case):
+        """LCPC from the min-SOE schedule is deterministic and matches the
+        frozen curve (the dispatch-SOE-seeded Usecase1 LCPC is not
+        comparable: equally-optimal dispatches differ, and the reference's
+        own value check is disabled — xtest_lcpc_meets_target)."""
+        compare_lcpc_results(
+            case, RES2 / "es/step1/load_coverage_probuc3_es_step1.csv",
+            MAX_PERCENT_ERROR + 2)
 
 
 class TestUsecase2EsPvSizing:
@@ -104,20 +110,26 @@ class TestLoadShedding:
     """Reliability with/without load shedding, fixed size + sizing
     (reference: test_reliability_module.py classes, 3% bounds)."""
 
-    @pytest.mark.parametrize("mp,golden", [
+    @pytest.mark.parametrize("mp,golden,check_lcpc", [
         ("mp/Model_Parameters_Template_DER_w_ls1.csv",
-         "results/reliability_load_shed1"),
+         "results/reliability_load_shed1", True),
         ("mp/Model_Parameters_Template_DER_wo_ls1.csv",
-         "results/reliability_load_shed_wo_ls1"),
+         "results/reliability_load_shed_wo_ls1", True),
         ("mp/Sizing/Model_Parameters_Template_DER_w_ls1.csv",
-         "results/Sizing/w_ls1"),
+         "results/Sizing/w_ls1", False),
     ])
-    def test_size_and_lcpc(self, mp, golden):
+    def test_size_proforma_lcpc(self, mp, golden, check_lcpc):
         inst = DERVET(LS / mp, base_path=REF).solve(
             backend="cpu").instances[0]
         compare_size_results(inst, LS / golden / "size_2mw_5hr.csv",
                              MAX_PERCENT_ERROR)
+        compare_proforma_results(inst, LS / golden / "pro_forma_2mw_5hr.csv",
+                                 MAX_PERCENT_ERROR)
         assert "load_coverage_prob" in inst.drill_down_dict
+        if check_lcpc:
+            compare_lcpc_results(
+                inst, LS / golden / "load_coverage_prob_2mw_5hr.csv",
+                MAX_PERCENT_ERROR)
 
 
 @pytest.fixture(scope="module")

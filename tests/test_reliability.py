@@ -61,7 +61,8 @@ def test_walk_matches_scalar_reference():
                   rte=0.85, dt=1.0)
     init = np.full(T, 120.0)
     cov, prof = _simulate_all_outages(
-        rc, dl, ec, init, params["ch_max"], params["dis_max"],
+        crit, gen, pv, pv, 1.0, np.ones(L), init,
+        params["ch_max"], params["dis_max"],
         params["e_min"], params["e_max"], params["rte"], params["dt"], L)
     cov = np.asarray(cov)
     prof = np.asarray(prof)
