@@ -70,7 +70,7 @@ class CostBenefitAnalysis:
     """Project-lifetime economics for one scenario case."""
 
     def __init__(self, finance: Dict, start_year: int, end_year: int,
-                 opt_years: List[int], dt: float = 1.0):
+                 opt_years: List[int], dt: float = 1.0, yearly=None):
         self.finance = finance
         g = lambda k, d=0.0: float(finance.get(k, d) or 0.0)
         self.inflation_rate = g("inflation_rate") / 100.0
@@ -85,6 +85,7 @@ class CostBenefitAnalysis:
         self.end_year = int(end_year)
         self.opt_years = sorted(int(y) for y in opt_years)
         self.dt = dt
+        self.yearly = yearly    # Year-indexed incentives data (optional)
         self.proforma: Optional[pd.DataFrame] = None
         self.npv: Optional[pd.DataFrame] = None
         self.payback: Optional[pd.DataFrame] = None
@@ -174,6 +175,9 @@ class CostBenefitAnalysis:
         stream_cols = [c for c in proforma.columns
                        if not any(c.startswith(d.unique_tech_id) for d in ders)]
         proforma = self._fill_forward(proforma, opt_years, stream_cols)
+        # incentives come from explicit per-year data — after fill-forward
+        # so missing years stay zero instead of escalating
+        self._external_incentive_columns(proforma)
         proforma = self._zero_out_dead_ders(proforma, ders)
         if self.ecc_mode:
             proforma = self._ecc_substitution(proforma, ders)
@@ -237,6 +241,25 @@ class CostBenefitAnalysis:
                 (self.end_year - base_yr)
         cols[f"{uid} Salvage Value"] = sal
         return cols
+
+    def _external_incentive_columns(self, proforma: pd.DataFrame) -> None:
+        """'Tax Credit' / 'Other Incentives' rows from the yearly data file
+        when external_incentives is on (reference: storagevet Financial
+        yearly-data surface; golden proforma columns)."""
+        if not self.external_incentives or self.yearly is None:
+            return
+        cols = {str(c).strip().lower(): c for c in self.yearly.columns}
+        for label, stem in (("Tax Credit", "tax credit"),
+                            ("Other Incentives", "other incentive")):
+            src = next((c for k, c in cols.items() if k.startswith(stem)),
+                       None)
+            if src is None:
+                continue
+            series = pd.Series(0.0, index=proforma.index, dtype=float)
+            for yr, val in self.yearly[src].items():
+                if yr in series.index:
+                    series[yr] = float(val)
+            proforma[label] = series
 
     def _zero_out_dead_ders(self, proforma: pd.DataFrame, ders
                             ) -> pd.DataFrame:
@@ -348,6 +371,10 @@ class CostBenefitAnalysis:
                 if "Capital Cost" in colname:
                     continue
                 if "Salvage" in colname or "Decommissioning" in colname:
+                    continue
+                # contract values paid only in optimized years (golden:
+                # User Constraints Value is zero outside opt years)
+                if colname == "User Constraints Value":
                     continue
                 if col[y] == 0.0 and col[src] != 0.0:
                     esc = (1 + self.inflation_rate) ** (y - src) \

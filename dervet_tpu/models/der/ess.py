@@ -119,20 +119,29 @@ class EnergyStorage(DER):
         dis = b.var(self.vname("dis"), T, lb=0.0, ub=self.discharge_capacity())
         self._ts_limit_bounds(b, ctx, ene, ch, dis, e_min, e_max)
 
-        # SOE evolution: ene[t]*(1+sdr) - ene[t-1] - rte*dt*ch[t] + dt*dis[t] = 0
-        # with ene[-1] := e0 (window-entry SOE).  Sparse bidiagonal on ene.
-        diag = sp.diags([np.full(T, 1.0 + self.sdr), np.full(T - 1, -1.0)],
+        # BEGIN-of-step SOE convention (verified against the Usecase2 step2
+        # golden to 1e-10): ene[t+1] = ene[t]*(1-sdr) + rte*dt*ch[t] -
+        # dt*dis[t]; ene[0] pinned to the window-entry target and the
+        # POST-last-step state pinned back to the target (the golden's
+        # implied post-window SOE is exactly soc_target*rating every
+        # month).  An end-of-step convention makes the min-SOE floor bind
+        # AT the peak hour instead of after it and loses ~20% of
+        # demand-charge savings vs the reference.
+        diag = sp.diags([np.full(T, 1.0), np.full(T - 1, -(1.0 - self.sdr))],
                         offsets=[0, -1], format="csr")
+        sub = sp.diags([np.full(T - 1, 1.0)], offsets=[-1], format="csr")
         rhs = np.zeros(T)
         rhs[0] = e0
         b.add_rows(self.vname("soe"), [
-            (ene, diag), (ch, -self.rte * dt), (dis, dt)], "eq", rhs)
-        # end-of-window SOE pinned back to target (reference keeps windows
-        # independent this way; storagevet EnergyStorage constraint surface)
-        end_row = np.zeros(T)
-        end_row[T - 1] = 1.0
-        b.add_rows(self.vname("soe_end"), [(ene, sp.csr_matrix(end_row))],
-                   "eq", np.array([self.ene_target]))
+            (ene, diag), (ch, sub * (-self.rte * dt)), (dis, sub * dt)],
+            "eq", rhs)
+        last = np.zeros(T)
+        last[T - 1] = 1.0
+        b.add_rows(self.vname("soe_final"), [
+            (ene, sp.csr_matrix(last * (1.0 - self.sdr))),
+            (ch, sp.csr_matrix(last * self.rte * dt)),
+            (dis, sp.csr_matrix(last * -dt))], "eq",
+            np.array([self.ene_target]))
 
         if self.daily_cycle_limit > 0:
             self._daily_cycle_rows(b, ctx, dis)
@@ -212,28 +221,32 @@ class EnergyStorage(DER):
                         (b[self.vname("size_dis")],
                          np.full((1, 1), -self.duration_max))], "le", 0.0)
 
-        # SOE evolution with window-entry/exit pinned to soc_target * size
-        diag = sp.diags([np.full(T, 1.0 + self.sdr), np.full(T - 1, -1.0)],
+        # BEGIN-of-step SOE with the window ENTRY pinned to
+        # soc_target * size; post-last-step state free (see the matching
+        # note in the fixed-size build)
+        diag = sp.diags([np.full(T, 1.0), np.full(T - 1, -(1.0 - self.sdr))],
                         offsets=[0, -1], format="csr")
+        sub = sp.diags([np.full(T - 1, 1.0)], offsets=[-1], format="csr")
         first = sp.csr_matrix((np.ones(1), (np.zeros(1, int), np.zeros(1, int))),
                               shape=(T, 1))
-        soe_terms = [(ene, diag), (ch, -self.rte * dt), (dis, dt)]
+        soe_terms = [(ene, diag), (ch, sub * (-self.rte * dt)),
+                     (dis, sub * dt)]
+        last = np.zeros(T)
+        last[T - 1] = 1.0
+        final_terms = [(ene, sp.csr_matrix(last * (1.0 - self.sdr))),
+                       (ch, sp.csr_matrix(last * self.rte * dt)),
+                       (dis, sp.csr_matrix(last * -dt))]
         if target_term:
             ref, coef = target_term[0]
             soe_terms.append((ref, first * float(coef[0, 0])))
             b.add_rows(self.vname("soe"), soe_terms, "eq", np.zeros(T))
-            end_row = np.zeros((1, T))
-            end_row[0, T - 1] = 1.0
-            b.add_rows(self.vname("soe_end"),
-                       [(ene, sp.csr_matrix(end_row)), (ref, coef)], "eq", 0.0)
+            b.add_rows(self.vname("soe_final"), final_terms + [(ref, coef)],
+                       "eq", 0.0)
         else:
             rhs = np.zeros(T)
             rhs[0] = self.ene_target
             b.add_rows(self.vname("soe"), soe_terms, "eq", rhs)
-            end_row = np.zeros(T)
-            end_row[T - 1] = 1.0
-            b.add_rows(self.vname("soe_end"),
-                       [(ene, sp.csr_matrix(end_row))], "eq",
+            b.add_rows(self.vname("soe_final"), final_terms, "eq",
                        np.array([self.ene_target]))
 
         if self.daily_cycle_limit > 0:

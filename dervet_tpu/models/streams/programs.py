@@ -101,7 +101,7 @@ class UserConstraints(ValueStream):
 
     def proforma_report(self, opt_years, poi, results) -> Optional[pd.DataFrame]:
         rows = {pd.Period(yr, freq="Y"): self.price for yr in opt_years}
-        return pd.DataFrame({"User Constraints": rows})
+        return pd.DataFrame({"User Constraints Value": rows})
 
 
 class Backup(ValueStream):

@@ -142,7 +142,8 @@ class CaseResult:
             # with different financial inputs than the optimization used
             ders, streams, finance = s.evaluation_clones()
             cba = CostBenefitAnalysis(finance, s.start_year, s.end_year,
-                                      s.opt_years, dt=s.dt)
+                                      s.opt_years, dt=s.dt,
+                                      yearly=s.case.datasets.yearly)
         except Exception as e:  # financial inputs optional in early slices
             TellUser.warning(f"CBA skipped: {e}")
             return

@@ -60,6 +60,16 @@ class TestUsecase2ReliabilitySizing:
         compare_size_results(case, RES2 / "es/step1/sizeuc3_es_step1.csv",
                              MAX_PERCENT_ERROR)
 
+    def test_step2_proforma_exact(self):
+        """Step2 (fixed size from step1, retail + DCM + User min-SOE floor):
+        the dispatch-dependent proforma reproduces the golden exactly —
+        avoided demand AND energy charges match to the cent."""
+        d = DERVET(UC2 / "Model_Parameters_Template_Usecase3_Planned_ES_Step2.csv",
+                   base_path=REF)
+        inst = d.solve(backend="cpu").instances[0]
+        compare_proforma_results(
+            inst, RES2 / "es/step2/pro_formauc3_es_step2.csv", 0.1)
+
     def test_lcpc_within_bound(self, case):
         """LCPC from the min-SOE schedule is deterministic and matches the
         frozen curve (the dispatch-SOE-seeded Usecase1 LCPC is not

@@ -42,7 +42,7 @@ def test_user_constraints_respected(solved_user):
     if emin is not None:
         ok = np.isfinite(emin)
         assert (soe[ok] >= emin[ok] - 1e-3).all()
-    assert "User Constraints" in inst.proforma_df.columns
+    assert "User Constraints Value" in inst.proforma_df.columns
 
 
 def test_deferral_runs_and_reports():
