@@ -160,6 +160,7 @@ class CostBenefitAnalysis:
             for name, series in cols.items():
                 proforma[name] = series
 
+        growth_map: Dict[str, float] = {}
         for vs in value_streams.values():
             df = vs.proforma_report(opt_years, poi, results)
             if df is None:
@@ -171,10 +172,19 @@ class CostBenefitAnalysis:
                     if yr in col.index:
                         col[yr] = val
                 proforma[name] = col
+                # each stream's columns escalate at that stream's own
+                # proforma growth rate in fill-forward years (reference:
+                # case 041 growth=0 stays flat, Usecase1 2.2% escalates);
+                # streams with fill_forward=False pay only in opt years
+                if not getattr(vs, "fill_forward", True):
+                    growth_map[name] = None
+                else:
+                    override = getattr(vs, "proforma_growth", None)
+                    growth_map[name] = float(
+                        override if override is not None
+                        else getattr(vs, "growth", 0.0) or 0.0)
 
-        stream_cols = [c for c in proforma.columns
-                       if not any(c.startswith(d.unique_tech_id) for d in ders)]
-        proforma = self._fill_forward(proforma, opt_years, stream_cols)
+        proforma = self._fill_forward(proforma, opt_years, growth_map)
         # incentives come from explicit per-year data — after fill-forward
         # so missing years stay zero instead of escalating
         self._external_incentive_columns(proforma)
@@ -257,7 +267,7 @@ class CostBenefitAnalysis:
                 continue
             series = pd.Series(0.0, index=proforma.index, dtype=float)
             for yr, val in self.yearly[src].items():
-                if yr in series.index:
+                if yr in series.index and not pd.isna(val):
                     series[yr] = float(val)
             proforma[label] = series
 
@@ -351,12 +361,12 @@ class CostBenefitAnalysis:
         return float(raw or 0)
 
     def _fill_forward(self, proforma: pd.DataFrame, opt_years: List[int],
-                      stream_cols: List[str]) -> pd.DataFrame:
+                      growth_map: Dict[str, float]) -> pd.DataFrame:
         """Fill each non-optimized year from the nearest previous optimized
-        year.  Value-stream columns escalate at the inflation rate; DER
-        operating-cost columns stay flat (behavior matched to the frozen
-        Usecase1 proforma: Avoided charges grow 2.2%/yr while Fixed O&M
-        holds at the optimized-year value)."""
+        year.  Each value-stream column escalates at that stream's own
+        growth rate (reference: case 041 retailETS growth=0 stays flat;
+        Usecase1 growth=2.2%/yr escalates); DER operating-cost columns stay
+        flat."""
         years = [y for y in proforma.index if y != CAPEX_ROW]
         opt_set = sorted(set(opt_years))
         for y in years:
@@ -372,14 +382,11 @@ class CostBenefitAnalysis:
                     continue
                 if "Salvage" in colname or "Decommissioning" in colname:
                     continue
-                # contract values paid only in optimized years (golden:
-                # User Constraints Value is zero outside opt years)
-                if colname == "User Constraints Value":
+                rate = growth_map.get(colname, 0.0)
+                if rate is None:      # paid only in optimized years
                     continue
                 if col[y] == 0.0 and col[src] != 0.0:
-                    esc = (1 + self.inflation_rate) ** (y - src) \
-                        if colname in stream_cols else 1.0
-                    proforma.loc[y, colname] = col[src] * esc
+                    proforma.loc[y, colname] = col[src] * (1 + rate) ** (y - src)
         return proforma
 
     # ------------------------------------------------------------------

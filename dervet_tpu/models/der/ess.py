@@ -127,21 +127,12 @@ class EnergyStorage(DER):
         # month).  An end-of-step convention makes the min-SOE floor bind
         # AT the peak hour instead of after it and loses ~20% of
         # demand-charge savings vs the reference.
-        diag = sp.diags([np.full(T, 1.0), np.full(T - 1, -(1.0 - self.sdr))],
-                        offsets=[0, -1], format="csr")
-        sub = sp.diags([np.full(T - 1, 1.0)], offsets=[-1], format="csr")
+        soe_terms, final_terms = self._soe_rows(ene, ch, dis, T, dt)
         rhs = np.zeros(T)
         rhs[0] = e0
-        b.add_rows(self.vname("soe"), [
-            (ene, diag), (ch, sub * (-self.rte * dt)), (dis, sub * dt)],
-            "eq", rhs)
-        last = np.zeros(T)
-        last[T - 1] = 1.0
-        b.add_rows(self.vname("soe_final"), [
-            (ene, sp.csr_matrix(last * (1.0 - self.sdr))),
-            (ch, sp.csr_matrix(last * self.rte * dt)),
-            (dis, sp.csr_matrix(last * -dt))], "eq",
-            np.array([self.ene_target]))
+        b.add_rows(self.vname("soe"), soe_terms, "eq", rhs)
+        b.add_rows(self.vname("soe_final"), final_terms, "eq",
+                   np.array([self.ene_target]))
 
         if self.daily_cycle_limit > 0:
             self._daily_cycle_rows(b, ctx, dis)
@@ -221,21 +212,13 @@ class EnergyStorage(DER):
                         (b[self.vname("size_dis")],
                          np.full((1, 1), -self.duration_max))], "le", 0.0)
 
-        # BEGIN-of-step SOE with the window ENTRY pinned to
-        # soc_target * size; post-last-step state free (see the matching
-        # note in the fixed-size build)
-        diag = sp.diags([np.full(T, 1.0), np.full(T - 1, -(1.0 - self.sdr))],
-                        offsets=[0, -1], format="csr")
-        sub = sp.diags([np.full(T - 1, 1.0)], offsets=[-1], format="csr")
+        # BEGIN-of-step SOE with both the window ENTRY and the
+        # post-last-step state pinned to soc_target * size (same convention
+        # as the fixed-size build, with the size variable supplying the
+        # target)
         first = sp.csr_matrix((np.ones(1), (np.zeros(1, int), np.zeros(1, int))),
                               shape=(T, 1))
-        soe_terms = [(ene, diag), (ch, sub * (-self.rte * dt)),
-                     (dis, sub * dt)]
-        last = np.zeros(T)
-        last[T - 1] = 1.0
-        final_terms = [(ene, sp.csr_matrix(last * (1.0 - self.sdr))),
-                       (ch, sp.csr_matrix(last * self.rte * dt)),
-                       (dis, sp.csr_matrix(last * -dt))]
+        soe_terms, final_terms = self._soe_rows(ene, ch, dis, T, dt)
         if target_term:
             ref, coef = target_term[0]
             soe_terms.append((ref, first * float(coef[0, 0])))
@@ -291,6 +274,23 @@ class EnergyStorage(DER):
         TellUser.info(f"{self.name} sized: {self.ene_max_rated:.1f} kWh, "
                       f"ch {self.ch_max_rated:.1f} kW / "
                       f"dis {self.dis_max_rated:.1f} kW")
+
+    def _soe_rows(self, ene, ch, dis, T: int, dt: float):
+        """Begin-of-step SOE constraint blocks shared by the fixed-size and
+        sizing builds: ``(soe_terms, final_terms)`` where soe_terms encode
+        ene[t+1] = ene[t]*(1-sdr) + rte*dt*ch[t] - dt*dis[t] (row 0 is the
+        entry pin) and final_terms the post-last-step state."""
+        diag = sp.diags([np.full(T, 1.0), np.full(T - 1, -(1.0 - self.sdr))],
+                        offsets=[0, -1], format="csr")
+        sub = sp.diags([np.full(T - 1, 1.0)], offsets=[-1], format="csr")
+        soe_terms = [(ene, diag), (ch, sub * (-self.rte * dt)),
+                     (dis, sub * dt)]
+        last = np.zeros(T)
+        last[T - 1] = 1.0
+        final_terms = [(ene, sp.csr_matrix(last * (1.0 - self.sdr))),
+                       (ch, sp.csr_matrix(last * self.rte * dt)),
+                       (dis, sp.csr_matrix(last * -dt))]
+        return soe_terms, final_terms
 
     def _ts_limit_bounds(self, b: LPBuilder, ctx: WindowContext, ene, ch,
                          dis, e_min: float, e_max: float) -> None:

@@ -39,6 +39,14 @@ class SystemRequirement:
 class ValueStream:
     """Base service/value stream."""
 
+    #: fill-forward behavior of this stream's proforma columns: True means
+    #: escalate at ``proforma_growth`` (which defaults to the stream's
+    #: growth key); False means the value is paid only in optimized years
+    fill_forward: bool = True
+    #: optional override of the fill-forward escalation rate (fraction/yr);
+    #: None means "use the stream's growth key"
+    proforma_growth: Optional[float] = None
+
     def __init__(self, tag: str, keys: Dict, scenario: Dict, datasets):
         self.tag = tag
         self.keys = keys

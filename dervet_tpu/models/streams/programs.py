@@ -55,6 +55,8 @@ class UserConstraints(ValueStream):
     yearly price (reference: storagevet UserConstraints surface; schema
     User.price)."""
 
+    fill_forward = False      # paid only in optimized years (step2 golden)
+
     POI_EXPORT = "POI: Max Export (kW)"
     POI_IMPORT = "POI: Max Import (kW)"
     ENE_MAX = "Aggregate Energy Max (kWh)"
@@ -151,7 +153,10 @@ class Deferral(ValueStream):
         super().__init__("Deferral", keys, scenario, datasets)
         g = lambda k, d=0.0: float(keys.get(k, d) or 0.0)
         self.price = g("price")                       # $/yr deferred
-        self.growth = g("growth") / 100.0             # deferral load growth
+        self.growth = g("growth") / 100.0             # deferral LOAD growth
+        # the contract price is a flat dollar value — the growth key is a
+        # load-projection rate, not a price escalator
+        self.proforma_growth = 0.0
         self.planned_load_limit = g("planned_load_limit")
         self.reverse_power_flow_limit = g("reverse_power_flow_limit")  # <= 0
         self.min_year_objective = int(g("min_year_objective"))

@@ -1,0 +1,54 @@
+"""Proforma semantics mirrored from the reference's test_2finances.py:
+degradation lowers later optimized years' energy value; non-optimized
+years fill forward at the STREAM's growth rate (flat when growth=0)."""
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dervet_tpu.api import DERVET
+
+REF = Path("/root/reference")
+MP = REF / "test/test_storagevet_features/model_params"
+
+
+@pytest.fixture(scope="module")
+def degradation_proforma():
+    d = DERVET(MP / "040-Degradation_Test_MP.csv", base_path=REF)
+    return d.solve(backend="cpu").instances[0].proforma_df
+
+
+class TestProformaWithDegradation:
+    """Reference TestProformaWithDegradation (040: degradation on,
+    retailETS growth 0, inflation 3%)."""
+
+    def test_all_project_years_present(self, degradation_proforma):
+        years = {i for i in degradation_proforma.index if i != "CAPEX Year"}
+        assert years == set(range(2017, 2031))
+
+    def test_all_years_filled(self, degradation_proforma):
+        assert np.all(degradation_proforma["Yearly Net Value"].to_numpy()
+                      != 0)
+
+    def test_degraded_year_earns_less(self, degradation_proforma):
+        ec = degradation_proforma["Avoided Energy Charge"]
+        assert ec[2017] > ec[2022]
+
+    def test_non_opt_years_flat_at_zero_growth(self, degradation_proforma):
+        ec = degradation_proforma["Avoided Energy Charge"]
+        for yr in range(2023, 2031):
+            assert ec[yr] == pytest.approx(ec[2022], rel=1e-9)
+
+
+class TestProformaWithoutDegradation:
+    """Reference TestProformaWithNoDegradation (041: degradation off)."""
+
+    @pytest.fixture(scope="class")
+    def proforma(self):
+        d = DERVET(MP / "041-no_Degradation_Test_MP.csv", base_path=REF)
+        return d.solve(backend="cpu").instances[0].proforma_df
+
+    def test_opt_years_equal_without_degradation(self, proforma):
+        ec = proforma["Avoided Energy Charge"]
+        assert ec[2017] == pytest.approx(ec[2022], rel=1e-6)

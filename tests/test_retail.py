@@ -135,12 +135,12 @@ def test_avoided_charges_in_proforma(solved_004):
     # avoided charges in optimized year are positive (battery shifts load)
     assert pf.loc[2017, "Avoided Energy Charge"] > 0
     assert pf.loc[2017, "Avoided Demand Charge"] > 0
-    # fill-forward escalates stream columns at the inflation rate
-    # (matched to the frozen Usecase1 proforma behavior)
+    # fill-forward escalates each stream column at that STREAM's growth
+    # rate (reference test_2finances semantics: growth=0 stays flat)
     s = inst.scenario
-    infl = float(s.case.finance.get("inflation_rate", 0)) / 100.0
+    growth = s.streams["retailTimeShift"].growth
     assert pf.loc[2025, "Avoided Energy Charge"] == pytest.approx(
-        pf.loc[2017, "Avoided Energy Charge"] * (1 + infl) ** 8)
+        pf.loc[2017, "Avoided Energy Charge"] * (1 + growth) ** 8)
 
 
 def test_objective_breakdown_labels(solved_004):
