@@ -160,7 +160,7 @@ class CostBenefitAnalysis:
             for name, series in cols.items():
                 proforma[name] = series
 
-        growth_map: Dict[str, float] = {}
+        growth_map: Dict[str, Optional[float]] = {}
         for vs in value_streams.values():
             df = vs.proforma_report(opt_years, poi, results)
             if df is None:
@@ -361,7 +361,7 @@ class CostBenefitAnalysis:
         return float(raw or 0)
 
     def _fill_forward(self, proforma: pd.DataFrame, opt_years: List[int],
-                      growth_map: Dict[str, float]) -> pd.DataFrame:
+                      growth_map: Dict[str, Optional[float]]) -> pd.DataFrame:
         """Fill each non-optimized year from the nearest previous optimized
         year.  Each value-stream column escalates at that stream's own
         growth rate (reference: case 041 retailETS growth=0 stays flat;

@@ -106,14 +106,16 @@ class MicrogridScenario:
         self.time_series = ts
         self.index = ts.index
         steps_per_hour = round(1 / self.dt)
-        for yr in self.opt_years:
-            n_steps = int((self.index.year == yr).sum())
-            from .window import hours_in_year
-            expected = int(hours_in_year(yr) / self.dt)
-            if n_steps not in (expected, 8760 * steps_per_hour):
-                raise TimeseriesDataError(
-                    f"year {yr}: {n_steps} steps in time series, expected "
-                    f"{expected} at dt={self.dt}")
+        if not self.scenario.get("allow_partial_year", False):
+            for yr in self.opt_years:
+                n_steps = int((self.index.year == yr).sum())
+                from .window import hours_in_year
+                expected = int(hours_in_year(yr) / self.dt)
+                if n_steps not in (expected, 8760 * steps_per_hour):
+                    raise TimeseriesDataError(
+                        f"year {yr}: {n_steps} steps in time series, expected "
+                        f"{expected} at dt={self.dt} (set allow_partial_year "
+                        "to run a partial horizon)")
 
         self.ders: List[DER] = []
         tech_map = _build_tech_map()
