@@ -13,7 +13,9 @@ from ..models.streams.base import SystemRequirement, ValueStream
 from ..utils.errors import ParameterError
 
 
-WHOLESALE_TAGS = {"DA", "FR", "SR", "NSR", "LF"}
+# the reference counts only the capacity/regulation markets as wholesale
+# (MicrogridServiceAggregator.py:73-79); DA energy time-shift is not one
+WHOLESALE_TAGS = {"FR", "SR", "NSR", "LF"}
 
 
 class ServiceAggregator:

@@ -106,16 +106,25 @@ class MicrogridScenario:
         self.time_series = ts
         self.index = ts.index
         steps_per_hour = round(1 / self.dt)
-        if not self.scenario.get("allow_partial_year", False):
-            for yr in self.opt_years:
-                n_steps = int((self.index.year == yr).sum())
-                from .window import hours_in_year
-                expected = int(hours_in_year(yr) / self.dt)
-                if n_steps not in (expected, 8760 * steps_per_hour):
-                    raise TimeseriesDataError(
-                        f"year {yr}: {n_steps} steps in time series, expected "
-                        f"{expected} at dt={self.dt} (set allow_partial_year "
-                        "to run a partial horizon)")
+        allow_partial = bool(self.scenario.get("allow_partial_year", False))
+        for yr in self.opt_years:
+            n_steps = int((self.index.year == yr).sum())
+            from .window import hours_in_year
+            expected = int(hours_in_year(yr) / self.dt)
+            if n_steps in (expected, 8760 * steps_per_hour):
+                continue
+            if allow_partial and n_steps < expected:
+                TellUser.warning(
+                    f"year {yr}: partial horizon ({n_steps}/{expected} "
+                    "steps) — non-optimized project years fill forward "
+                    "from PARTIAL-year values")
+                continue
+            # too many steps is a data-integrity error regardless of the
+            # partial-year gate (duplicated timestamps / DST artifacts)
+            raise TimeseriesDataError(
+                f"year {yr}: {n_steps} steps in time series, expected "
+                f"{expected} at dt={self.dt} (set allow_partial_year "
+                "to run a shorter horizon)")
 
         self.ders: List[DER] = []
         tech_map = _build_tech_map()

@@ -1,0 +1,52 @@
+"""Deferral-driven ESS sizing floors (reference:
+MicrogridServiceAggregator.set_size, :81-107 — the deferral power/energy
+requirements become minimum ESS ratings in a sizing run)."""
+from pathlib import Path
+
+import pytest
+
+from dervet_tpu.io.params import Params
+from dervet_tpu.scenario.scenario import MicrogridScenario
+
+REF = Path("/root/reference")
+MP = REF / "test/test_storagevet_features/model_params"
+
+
+def test_deferral_floors_sizing():
+    cases = Params.initialize(MP / "003-DA_Deferral_battery_month.csv",
+                              base_path=REF)
+    case = cases[0]
+    for tag, _, keys in case.ders:
+        if tag == "Battery":
+            keys["ene_max_rated"] = 0
+            keys["ch_max_rated"] = 0
+            keys["dis_max_rated"] = 0
+    case.scenario["n"] = "year"
+    case.scenario["binary"] = False   # sizing forbids the binary formulation
+    s = MicrogridScenario(case)
+    s.optimize_problem_loop(backend="cpu")
+    d = s.streams["Deferral"]
+    req = d.deferral_df.iloc[0]
+    bat = s.ders[0]
+    assert bat.dis_max_rated >= float(req["Power Requirement (kW)"]) - 1e-6
+    assert bat.ene_max_rated >= float(req["Energy Requirement (kWh)"]) - 1e-6
+    assert bat.dis_max_rated > 0
+
+
+def test_deferral_sizing_requires_single_ess():
+    from dervet_tpu.utils.errors import ParameterError
+    cases = Params.initialize(MP / "003-DA_Deferral_battery_month.csv",
+                              base_path=REF)
+    case = cases[0]
+    for tag, _, keys in case.ders:
+        if tag == "Battery":
+            keys["ene_max_rated"] = 0
+    case.ders.append(("ICE", "1", {
+        "name": "g", "rated_capacity": 100, "n": 1, "efficiency": 0.05,
+        "fuel_cost": 3, "variable_om_cost": 0, "fixed_om_cost": 0,
+        "ccost": 0, "ccost_kW": 500}))
+    case.scenario["n"] = "year"
+    case.scenario["binary"] = False
+    s = MicrogridScenario(case)
+    with pytest.raises(ParameterError):
+        s.optimize_problem_loop(backend="cpu")

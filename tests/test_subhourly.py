@@ -52,8 +52,7 @@ def test_5min_dispatch_physics():
     ch = ts["BATTERY: b5 Charge (kW)"].to_numpy()
     dis = ts["BATTERY: b5 Discharge (kW)"].to_numpy()
     ene = ts["BATTERY: b5 State of Energy (kWh)"].to_numpy()
-    # begin-of-step dynamics with dt = 5 min
-    labels = s.windows[0].index  # windows are 12h = 144 steps
+    # begin-of-step dynamics with dt = 5 min; windows are 12h = 144 steps
     n_win = len(s.windows)
     step = 144
     for w in range(n_win):
