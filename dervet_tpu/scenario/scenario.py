@@ -121,6 +121,11 @@ class MicrogridScenario:
                 continue
             # too many steps is a data-integrity error regardless of the
             # partial-year gate (duplicated timestamps / DST artifacts)
+            if n_steps > expected:
+                raise TimeseriesDataError(
+                    f"year {yr}: {n_steps} steps in time series but only "
+                    f"{expected} exist at dt={self.dt} — check for "
+                    "duplicated timestamps / DST artifacts")
             raise TimeseriesDataError(
                 f"year {yr}: {n_steps} steps in time series, expected "
                 f"{expected} at dt={self.dt} (set allow_partial_year "
@@ -221,20 +226,22 @@ class MicrogridScenario:
             self.opt_engine = False
 
     def _deferral_set_min_size(self, deferral) -> None:
-        """Deferral requirements floor the ESS size variables (reference
-        MicrogridServiceAggregator.set_size, :81-107)."""
+        """Deferral requirements floor the ESS size variables at the LAST
+        deferred year's (growth-scaled, largest) requirement; both power
+        ratings are floored (reference MicrogridServiceAggregator.set_size,
+        :81-107 uses deferral_df.loc[start + min_years - 1] and applies the
+        min power to ch_max_rated and dis_max_rated)."""
         dd = deferral.deferral_df
         if dd is None or not len(dd):
             return
-        p_req = float(dd["Power Requirement (kW)"].iloc[0])
-        e_req = float(dd["Energy Requirement (kWh)"].iloc[0])
+        last_deferred = self.start_year + max(deferral.min_years - 1, 0)
+        row = dd.loc[last_deferred] if last_deferred in dd.index else dd.iloc[0]
+        p_req = float(row["Power Requirement (kW)"])
+        e_req = float(row["Energy Requirement (kWh)"])
         ess = self.ders[0]
-        lo_e, hi_e = ess.user_bounds["ene"]
-        lo_d, hi_d = ess.user_bounds["dis"]
-        ess.user_bounds["ene"] = (max(lo_e, e_req), hi_e)
-        ess.user_bounds["dis"] = (max(lo_d, p_req), hi_d)
-        ess.user_bounds["ch"] = (max(ess.user_bounds["ch"][0], p_req),
-                                 ess.user_bounds["ch"][1])
+        for which, req in (("ene", e_req), ("dis", p_req), ("ch", p_req)):
+            lo, hi = ess.user_bounds[which]
+            ess.user_bounds[which] = (max(lo, req), hi)
 
     # ------------------------------------------------------------------
     def _checkpoint_path(self, checkpoint_dir):

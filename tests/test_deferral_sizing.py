@@ -26,9 +26,14 @@ def test_deferral_floors_sizing():
     s = MicrogridScenario(case)
     s.optimize_problem_loop(backend="cpu")
     d = s.streams["Deferral"]
-    req = d.deferral_df.iloc[0]
+    # floors use the LAST deferred year's (growth-scaled) requirement
+    # (reference set_size semantics), and both power ratings are floored
+    last = s.start_year + max(d.min_years - 1, 0)
+    req = d.deferral_df.loc[last] if last in d.deferral_df.index \
+        else d.deferral_df.iloc[0]
     bat = s.ders[0]
     assert bat.dis_max_rated >= float(req["Power Requirement (kW)"]) - 1e-6
+    assert bat.ch_max_rated >= float(req["Power Requirement (kW)"]) - 1e-6
     assert bat.ene_max_rated >= float(req["Energy Requirement (kWh)"]) - 1e-6
     assert bat.dis_max_rated > 0
 

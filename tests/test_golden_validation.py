@@ -113,6 +113,31 @@ class TestUsecase2EsPvDgSizing:
             MAX_PERCENT_ERROR)
 
 
+UC3 = REF / "test/test_validation_report_sept1/Model_params/Usecase3"
+RES3 = REF / "test/test_validation_report_sept1/Results/Usecase3"
+
+
+class TestUsecase3ReliabilitySizing:
+    """Usecase3 planned/unplanned reliability sizing across DER mixes."""
+
+    @pytest.mark.parametrize("mp,golden", [
+        ("planned/Model_Parameters_Template_Usecase3_Planned_ES.csv",
+         "planned/es/sizeuc3.csv"),
+        ("planned/Model_Parameters_Template_Usecase3_Planned_ES+PV.csv",
+         "planned/es+pv/sizeuc3.csv"),
+        ("planned/Model_Parameters_Template_Usecase3_Planned_ES+PV+DG.csv",
+         "planned/es+pv+dg/sizeuc3.csv"),
+        ("unplanned/Model_Parameters_Template_Usecase3_UnPlanned_ES.csv",
+         "unplanned/es/sizeuc3.csv"),
+        ("unplanned/Model_Parameters_Template_Usecase3_UnPlanned_ES+PV+DG.csv",
+         "unplanned/es+pv+dg/sizeuc3.csv"),
+    ])
+    def test_size_within_bound(self, mp, golden):
+        inst = DERVET(UC3 / mp, base_path=REF).solve(
+            backend="cpu").instances[0]
+        compare_size_results(inst, RES3 / golden, MAX_PERCENT_ERROR)
+
+
 LS = REF / "test/test_load_shedding"
 
 
