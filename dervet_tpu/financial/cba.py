@@ -22,7 +22,7 @@ from typing import Dict, List, Optional
 import numpy as np
 import pandas as pd
 
-from ..utils.errors import ParameterError, TellUser
+from ..utils.errors import ModelParameterError, ParameterError, TellUser
 
 # MACRS half-year convention depreciation schedules (% of basis per year),
 # standard IRS tables (reference carries the same tables, CBA.py:81-92)
@@ -189,12 +189,66 @@ class CostBenefitAnalysis:
         # so missing years stay zero instead of escalating
         self._external_incentive_columns(proforma)
         proforma = self._zero_out_dead_ders(proforma, ders)
+        proforma = self._move_capex_to_construction_year(proforma, ders)
+        # an all-zero CAPEX Year row is dropped (reference CBA.py:316-318);
+        # npv discounting is row-positional on both sides, so the drop
+        # shifts year-1 cashflows to k=0 exactly as the reference does
+        if CAPEX_ROW in proforma.index \
+                and not proforma.loc[CAPEX_ROW].abs().any():
+            proforma = proforma.drop(index=CAPEX_ROW)
+        # ECC substitution and income taxes are mutually exclusive branches
+        # in the reference (CBA.py:323-346: `if ecc_mode ... else
+        # calculate_taxes`)
         if self.ecc_mode:
             proforma = self._ecc_substitution(proforma, ders)
-        taxes = self.calculate_taxes(proforma, ders)
-        proforma["Overall Tax Burden"] = (
-            taxes if taxes is not None else 0.0)
+        else:
+            proforma = self.calculate_taxes(proforma, ders)
+        proforma = proforma.sort_index(axis=1)
+        proforma = proforma.fillna(0.0)
         proforma["Yearly Net Value"] = proforma.sum(axis=1)
+        return proforma
+
+    def ecc_checks(self, ders, streams: Dict) -> None:
+        """ECC-mode validity: an economic-carrying-cost analysis requires a
+        Reliability or Deferral service, and every DER's technology
+        escalation rate must stay below the project discount rate
+        (reference CBA.py:132-158)."""
+        if not set(streams) & {"Reliability", "Deferral"}:
+            TellUser.error(
+                "An ecc analysis does not make sense for the case you "
+                "selected. A reliability or asset deferral case would be "
+                "better suited for economic carrying cost analysis")
+            raise ModelParameterError(
+                "The combination of services does not work with the rest "
+                "of your case settings. Please see log file for more "
+                "information.")
+        for der in ders:
+            if der.escalation_rate >= self.npv_discount_rate:
+                TellUser.error(
+                    f"The technology escalation rate "
+                    f"({der.escalation_rate}) cannot be greater than the "
+                    f"project discount rate ({self.npv_discount_rate}). "
+                    f"Please edit the 'ter' value for {der.name}.")
+                raise ModelParameterError(
+                    "TER and discount rates conflict. Please see log file "
+                    "for more information.")
+
+    def _move_capex_to_construction_year(self, proforma: pd.DataFrame,
+                                         ders) -> pd.DataFrame:
+        """Capital cost lands on the construction year when construction
+        starts at or after the project start year; otherwise it stays in
+        the CAPEX Year row (reference CBA.py:392-407 +
+        DERExtension.put_capital_cost_on_construction_year, :190-206)."""
+        for der in ders:
+            cy = der.construction_year
+            if not cy or cy < self.start_year:
+                continue
+            col = f"{der.unique_tech_id} Capital Cost"
+            if col not in proforma.columns:
+                continue
+            proforma[col] = 0.0
+            if cy in proforma.index:
+                proforma.loc[cy, col] = -der.get_capex()
         return proforma
 
     def _der_columns(self, der, opt_years, results) -> Dict[str, pd.Series]:
@@ -338,27 +392,27 @@ class CostBenefitAnalysis:
         return pd.DataFrame(cols)
 
     def _salvage_value(self, der, capex: float) -> float:
-        """'sunk cost' -> 0; 'linear salvage value' -> capex * remaining
-        fraction of expected lifetime at end of analysis; numeric -> $."""
+        """'sunk cost' -> 0; otherwise salvage applies only when the (last
+        replacement's) life extends beyond the analysis end: 'linear
+        salvage value' -> capex * years-beyond-project / lifetime; numeric
+        -> $ (reference DERExtension.calculate_salvage_value, :218-250)."""
         raw = der.keys.get("salvage_value", 0)
-        if isinstance(raw, str):
-            label = raw.strip().lower()
-            if label == "sunk cost":
-                return 0.0
-            if label == "linear salvage value":
-                lifetime = int(der.keys.get("expected_lifetime", 0) or 0)
-                op_year = int(der.keys.get("operation_year", self.start_year)
-                              or self.start_year)
-                if not lifetime:
-                    return 0.0
-                used = self.end_year - op_year + 1
-                frac = max(0.0, (lifetime - used) / lifetime)
-                return capex * frac
-            try:
-                return float(raw)
-            except ValueError:
-                return 0.0
-        return float(raw or 0)
+        label = raw.strip().lower() if isinstance(raw, str) else None
+        if label == "sunk cost":
+            return 0.0
+        last_op = getattr(der, "last_operation_year", self.end_year)
+        if last_op + 1 <= self.end_year:
+            return 0.0
+        years_beyond = last_op - self.end_year
+        if years_beyond < 0:
+            return 0.0
+        if label == "linear salvage value":
+            lifetime = der.expected_lifetime
+            return capex * years_beyond / lifetime if lifetime else 0.0
+        try:
+            return float(raw or 0)
+        except ValueError:
+            return 0.0
 
     def _fill_forward(self, proforma: pd.DataFrame, opt_years: List[int],
                       growth_map: Dict[str, Optional[float]]) -> pd.DataFrame:
@@ -391,39 +445,63 @@ class CostBenefitAnalysis:
 
     # ------------------------------------------------------------------
     def calculate_taxes(self, proforma: pd.DataFrame, ders
-                        ) -> Optional[pd.Series]:
+                        ) -> pd.DataFrame:
         """MACRS depreciation + state/federal income tax on yearly net
-        income (reference CBA.py:440-477)."""
-        overall_rate = (self.federal_tax_rate
-                        + self.state_tax_rate * (1 - self.federal_tax_rate))
-        if overall_rate == 0:
-            return None
-        years = [y for y in proforma.index if y != CAPEX_ROW]
-        depreciation = pd.Series(0.0, index=years)
+        income (reference CBA.py:440-477): per-DER MACRS columns plus a
+        capex 'disregard' column cancel capital costs out of taxable
+        income; state tax applies to the net of every year (negative years
+        earn a credit), federal tax applies net-of-state-tax; all three
+        burden columns are added to the proforma exactly as the reference
+        does."""
+        tax_calcs = proforma.copy(deep=True)
         for der in ders:
-            term = der.keys.get("macrs_term")
-            capex = der.get_capex()
-            if not term or not capex:
-                continue
-            table = MACRS_TABLES.get(int(float(term)))
-            if table is None:
-                TellUser.warning(f"no MACRS table for term {term}; skipped")
-                continue
-            op_year = int(der.keys.get("operation_year", self.start_year)
-                          or self.start_year)
-            for k, pct in enumerate(table):
-                yr = op_year + k
-                if yr in depreciation.index:
-                    depreciation[yr] += -capex * pct / 100.0
-        taxes = pd.Series(0.0, index=[CAPEX_ROW] + years)
-        yearly_net = proforma.loc[years].sum(axis=1)
-        taxable = yearly_net + depreciation
-        burden = -taxable.clip(lower=0.0) * overall_rate
-        taxes.loc[years] = burden
-        self.tax_breakdown = pd.DataFrame({
-            "Depreciation": depreciation, "Taxable Income": taxable,
-            "Tax Burden": burden})
-        return taxes
+            contrib = self._tax_contribution(der, tax_calcs.index)
+            if contrib is not None:
+                tax_calcs = pd.concat([tax_calcs, contrib], axis=1)
+        yearly_net = tax_calcs.sum(axis=1)
+        tax_calcs["Taxable Yearly Net"] = yearly_net
+        state = yearly_net * -self.state_tax_rate
+        tax_calcs["State Tax Burden"] = state
+        federal = (yearly_net + state) * -self.federal_tax_rate
+        tax_calcs["Federal Tax Burden"] = federal
+        tax_calcs["Overall Tax Burden"] = state + federal
+        self.tax_breakdown = tax_calcs
+        proforma["State Tax Burden"] = state
+        proforma["Federal Tax Burden"] = federal
+        proforma["Overall Tax Burden"] = state + federal
+        return proforma
+
+    def _tax_contribution(self, der, index) -> Optional[pd.DataFrame]:
+        """MACRS Depreciation + Disregard From Taxable Income columns for
+        one DER (reference DERExtension.tax_contribution, :308-349):
+        depreciation starts at max(construction_year + 1, start_year);
+        the disregard adds capex back so taxable income excludes it."""
+        term = der.keys.get("macrs_term")
+        if not term:
+            return None
+        table = MACRS_TABLES.get(int(float(term)))
+        if table is None:
+            TellUser.warning(f"no MACRS table for term {term}; skipped")
+            return None
+        capex = der.get_capex()
+        uid = der.unique_tech_id
+        out = pd.DataFrame(
+            0.0, index=index,
+            columns=[f"{uid} MACRS Depreciation",
+                     f"{uid} Disregard From Taxable Income"])
+        cy = der.construction_year
+        start_taxing = max((cy + 1) if cy else self.start_year,
+                           self.start_year)
+        years = [y for y in index if y != CAPEX_ROW and y >= start_taxing]
+        for k, yr in enumerate(years):
+            pct = table[k] if k < len(table) else 0.0
+            out.loc[yr, f"{uid} MACRS Depreciation"] = -capex * pct / 100.0
+        disregard_row = (CAPEX_ROW if start_taxing == self.start_year
+                         else cy)
+        if disregard_row in out.index:
+            out.loc[disregard_row,
+                    f"{uid} Disregard From Taxable Income"] = capex
+        return out
 
     # ------------------------------------------------------------------
     def npv_report(self, proforma: pd.DataFrame) -> pd.DataFrame:
@@ -441,8 +519,9 @@ class CostBenefitAnalysis:
     def payback_report(self, proforma: pd.DataFrame) -> pd.DataFrame:
         """Simple payback = capex / first-year net benefit; discounted
         payback from cumulative discounted net (reference CBA.py:479-523)."""
-        capex = -float(proforma.loc[CAPEX_ROW].drop(
+        capex = (-float(proforma.loc[CAPEX_ROW].drop(
             labels=["Yearly Net Value"], errors="ignore").sum())
+            if CAPEX_ROW in proforma.index else 0.0)
         years = [y for y in proforma.index if y != CAPEX_ROW]
         net = proforma.loc[years, "Yearly Net Value"].to_numpy(dtype=float)
         first = net[0] if len(net) else 0.0

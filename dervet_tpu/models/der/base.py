@@ -28,7 +28,10 @@ class DER:
     def __init__(self, tag: str, der_id: str, keys: Dict, scenario: Dict):
         self.tag = tag
         self.id = der_id or ""
-        self.name = str(keys.get("name", tag))
+        # the reference lowercases DER names in every output column: input
+        # name=ES yields 'BATTERY: es ...' in its frozen goldens, name=Battery
+        # yields 'BATTERY: battery Discharge (kW)' (test_technology_features)
+        self.name = str(keys.get("name", tag)).lower()
         self.dt = float(scenario.get("dt", 1))
         self.keys = keys
         self.scenario = scenario
@@ -178,11 +181,14 @@ class DER:
         if last <= end_year:
             years.append(last)
         if self.replaceable:
+            # the final replacement's last operating year lands at or past
+            # the analysis end (reference DERExtension.py:106-112) — salvage
+            # value keys off how far it outlives the project
             nxt = last + lifetime
             while nxt < end_year:
                 years.append(nxt)
                 nxt += lifetime
-            self.last_operation_year = end_year
+            self.last_operation_year = nxt
         else:
             self.last_operation_year = last
         self.failure_years = sorted(set(years))

@@ -161,6 +161,8 @@ class MicrogridScenario:
                           f"{self.end_year} -> {new_end}")
             self.end_year = new_end
             self.cba.end_year = new_end
+        if self.cba.ecc_mode:
+            self.cba.ecc_checks(self.ders, self.streams)
         # lifecycle horizon must be known BEFORE dispatch so that
         # grab_active_ders can drop equipment past its end of life
         for der in self.ders:

@@ -36,7 +36,9 @@ def test_failure_years_non_replaceable():
 def test_failure_years_replaceable():
     b = _battery(operation_year=2017, expected_lifetime=5, replaceable=1)
     assert b.set_failure_years(2030) == [2021, 2026]
-    assert b.last_operation_year == 2030
+    # the final replacement (installed 2027) operates through 2031 — one
+    # year beyond the analysis end (reference DERExtension.py:106-112)
+    assert b.last_operation_year == 2031
     assert b.operational(2030)
 
 
@@ -104,5 +106,5 @@ def test_equipment_lifetimes_saved(tmp_path):
     res = d.solve(backend="cpu")
     res.save_as_csv(tmp_path)
     el = pd.read_csv(tmp_path / "equipment_lifetimes.csv", index_col=0)
-    assert "BATTERY: ES" in el.columns
-    assert int(el.loc["End of Life", "BATTERY: ES"]) == 2116
+    assert "BATTERY: es" in el.columns
+    assert int(el.loc["End of Life", "BATTERY: es"]) == 2116

@@ -30,8 +30,8 @@ def test_end_to_end_runs(solved_cpu):
     inst = solved_cpu.instances[0]
     ts = inst.time_series_data
     assert len(ts) == 8760
-    for col in ["BATTERY: Battery Charge (kW)", "BATTERY: Battery Discharge (kW)",
-                "BATTERY: Battery State of Energy (kWh)", "BATTERY: Battery SOC (%)",
+    for col in ["BATTERY: battery Charge (kW)", "BATTERY: battery Discharge (kW)",
+                "BATTERY: battery State of Energy (kWh)", "BATTERY: battery SOC (%)",
                 "Net Load (kW)", "Total Storage Power (kW)", "DA Price ($/kWh)"]:
         assert col in ts.columns, col
 
@@ -39,9 +39,9 @@ def test_end_to_end_runs(solved_cpu):
 def test_battery_physics(solved_cpu):
     inst = solved_cpu.instances[0]
     ts = inst.time_series_data
-    ch = ts["BATTERY: Battery Charge (kW)"].to_numpy()
-    dis = ts["BATTERY: Battery Discharge (kW)"].to_numpy()
-    ene = ts["BATTERY: Battery State of Energy (kWh)"].to_numpy()
+    ch = ts["BATTERY: battery Charge (kW)"].to_numpy()
+    dis = ts["BATTERY: battery Discharge (kW)"].to_numpy()
+    ene = ts["BATTERY: battery State of Energy (kWh)"].to_numpy()
     tol = 1e-4
     assert (ch >= -tol).all() and (ch <= 1000 + tol).all()
     assert (dis >= -tol).all() and (dis <= 1000 + tol).all()
@@ -63,7 +63,7 @@ def test_battery_physics(solved_cpu):
 def test_objective_negative_value_possible(solved_cpu):
     """DA arbitrage must produce nonzero dispatch with these prices."""
     inst = solved_cpu.instances[0]
-    dis = inst.time_series_data["BATTERY: Battery Discharge (kW)"]
+    dis = inst.time_series_data["BATTERY: battery Discharge (kW)"]
     assert dis.sum() > 0
 
 
@@ -71,8 +71,12 @@ def test_financials_present(solved_cpu):
     inst = solved_cpu.instances[0]
     assert inst.proforma_df is not None
     assert "Yearly Net Value" in inst.proforma_df.columns
-    assert "BATTERY: Battery Capital Cost" in inst.proforma_df.columns
-    assert inst.proforma_df.loc["CAPEX Year", "BATTERY: Battery Capital Cost"] \
+    assert "BATTERY: battery Capital Cost" in inst.proforma_df.columns
+    # construction_year == start_year (2017): capex lands on 2017 and the
+    # all-zero CAPEX Year row is dropped (reference CBA.py:316-318 +
+    # put_capital_cost_on_construction_year)
+    assert "CAPEX Year" not in inst.proforma_df.index
+    assert inst.proforma_df.loc[2017, "BATTERY: battery Capital Cost"] \
         == pytest.approx(-(100 * 1000 + 800 * 2000))
     assert inst.npv_df is not None and "DA ETS" in inst.npv_df.columns
     assert float(inst.npv_df["DA ETS"].iloc[0]) > 0

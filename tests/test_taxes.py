@@ -1,0 +1,147 @@
+"""Tax semantics mirrored from the reference's TestAssetDepreciation
+(test_cba_validation/test_cba.py:328-358): exact MACRS depreciation
+schedule, the capex 'disregard' zeroing taxable income in the CAPEX year,
+and state/federal burdens opposing the sign of taxable income — plus
+end-of-life salvage coverage on the reference's cba-validation inputs.
+"""
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dervet_tpu.api import DERVET
+from dervet_tpu.utils.errors import ModelParameterError
+
+REF = Path("/root/reference")
+MP = REF / "test/model_params"
+CBA_MP = REF / "test/test_cba_validation/model_params"
+
+
+@pytest.fixture(scope="module")
+def tax_case():
+    res = DERVET(MP / "002-tax_scenario.csv", base_path=REF).solve(
+        backend="cpu")
+    return res.instances[0]
+
+
+class TestAssetDepreciation:
+    """Reference TestAssetDepreciation on 002-tax_scenario.csv (federal
+    23%, state 10%, battery capex 825k on a 3-year MACRS schedule)."""
+
+    def test_macrs_depreciation(self, tax_case):
+        expected = [0, -274972.5, -366712.5, -122182.5, -61132.5, 0, 0, 0,
+                    0, 0, 0, 0, 0, 0, 0]
+        actual = tax_case.tax_breakdown_df[
+            "BATTERY: es MACRS Depreciation"].values
+        assert list(actual) == pytest.approx(expected)
+
+    def test_zero_tax_in_capex(self, tax_case):
+        assert tax_case.tax_breakdown_df.loc[
+            "CAPEX Year", "Taxable Yearly Net"] == pytest.approx(0.0)
+
+    def test_sign_of_state_tax(self, tax_case):
+        df = tax_case.tax_breakdown_df
+        rows = df[df.index != "CAPEX Year"]
+        taxable = rows["Taxable Yearly Net"].values
+        state = rows["State Tax Burden"].values
+        assert np.all(np.sign(taxable) != np.sign(state))
+
+    def test_sign_of_federal_tax(self, tax_case):
+        df = tax_case.tax_breakdown_df
+        rows = df[df.index != "CAPEX Year"]
+        taxable = rows["Taxable Yearly Net"].values
+        federal = rows["Federal Tax Burden"].values
+        assert np.all(np.sign(taxable) != np.sign(federal))
+
+    def test_burdens_in_proforma(self, tax_case):
+        pf = tax_case.proforma_df
+        for col in ("State Tax Burden", "Federal Tax Burden",
+                    "Overall Tax Burden"):
+            assert col in pf.columns
+        rows = pf[pf.index != "CAPEX Year"]
+        assert rows["Overall Tax Burden"].values == pytest.approx(
+            rows["State Tax Burden"].values
+            + rows["Federal Tax Burden"].values)
+
+
+def test_linear_salvage_value_runs():
+    """006-linear_salvage_value runs end-to-end (its battery life exactly
+    spans the analysis window and salvage_value=0, so no salvage lands —
+    reference calculate_salvage_value returns 0 when the equipment does
+    not outlive the project)."""
+    res = DERVET(CBA_MP / "006-linear_salvage_value.csv",
+                 base_path=REF).solve(backend="cpu")
+    pf = res.instances[0].proforma_df
+    salvage_cols = [c for c in pf.columns if "Salvage" in c]
+    assert salvage_cols
+    assert sum(abs(pf[c]).sum() for c in salvage_cols) == 0
+
+
+def test_linear_salvage_semantics():
+    """Linear salvage = capex * years-beyond-project / lifetime, gated on
+    the equipment outliving the analysis (reference
+    DERExtension.calculate_salvage_value)."""
+    from dervet_tpu.financial.cba import CostBenefitAnalysis
+    from dervet_tpu.models.der.base import DER
+
+    cba = CostBenefitAnalysis({}, 2017, 2030, [2017], 1.0)
+
+    class Dummy(DER):
+        def __init__(self, keys):
+            super().__init__("Battery", "1", keys, {})
+
+    # lifetime 20 from 2017 -> outlives 2030 by 6 years: 6/20 of capex
+    d = Dummy({"name": "b", "salvage_value": "Linear Salvage Value",
+               "expected_lifetime": 20, "operation_year": 2017})
+    d.set_failure_years(2030, 2017)
+    assert cba._salvage_value(d, 1000.0) == pytest.approx(1000.0 * 6 / 20)
+
+    # life ends exactly at the analysis end: no salvage
+    d2 = Dummy({"name": "b", "salvage_value": "Linear Salvage Value",
+                "expected_lifetime": 14, "operation_year": 2017})
+    d2.set_failure_years(2030, 2017)
+    assert cba._salvage_value(d2, 1000.0) == 0.0
+
+    # replaceable short-lived equipment: the last replacement outlives the
+    # project, so salvage applies (reference: "if it has a life shorter
+    # than the analysis window but is replaced, a salvage value applies")
+    d3 = Dummy({"name": "b", "salvage_value": "Linear Salvage Value",
+                "expected_lifetime": 10, "operation_year": 2017,
+                "replaceable": 1})
+    d3.set_failure_years(2030, 2017)
+    assert d3.last_operation_year == 2036
+    assert cba._salvage_value(d3, 1000.0) == pytest.approx(1000.0 * 6 / 10)
+
+    # user-specified $ salvage: the reference's gate is strictly
+    # last_op + 1 <= end, so a life ending exactly at the analysis end
+    # still earns the $ amount, but dying a year earlier does not
+    d4 = Dummy({"name": "b", "salvage_value": 500,
+                "expected_lifetime": 14, "operation_year": 2017})
+    d4.set_failure_years(2030, 2017)
+    assert cba._salvage_value(d4, 1000.0) == 500.0
+    d5 = Dummy({"name": "b", "salvage_value": 500,
+                "expected_lifetime": 13, "operation_year": 2017})
+    d5.set_failure_years(2030, 2017)
+    assert cba._salvage_value(d5, 1000.0) == 0.0
+
+
+def test_degradation_not_replaceable_runs():
+    """043: cycle degradation with a non-replaceable battery runs through
+    the full pipeline."""
+    res = DERVET(CBA_MP / "043-Degradation_Test_MP_not_replaceable.csv",
+                 base_path=REF).solve(backend="cpu")
+    assert res.instances[0].proforma_df is not None
+
+
+def test_ecc_requires_reliability_or_deferral():
+    """ecc_checks: ECC mode without a Reliability/Deferral service raises
+    (reference CBA.py:132-158)."""
+    from dervet_tpu.io.params import Params
+    from dervet_tpu.scenario.scenario import MicrogridScenario
+    cases = Params.initialize(
+        REF / "test/test_storagevet_features/model_params/"
+              "000-DA_battery_month.csv", base_path=REF)
+    case = cases[0]
+    case.finance["ecc_mode"] = 1
+    with pytest.raises(ModelParameterError):
+        MicrogridScenario(case)
