@@ -34,8 +34,7 @@ def log(msg: str) -> None:
 def main() -> None:
     import jax
 
-    from dervet_tpu.benchlib import (build_window_lps, scenario_price_batch,
-                                     synthetic_case)
+    from dervet_tpu.benchlib import build_window_lps, synthetic_case
     from dervet_tpu.ops.pdhg import CompiledLPSolver, PDHGOptions
 
     n_scen = int(os.environ.get("BENCH_SCENARIOS", BASELINE_SCENARIOS))
@@ -48,22 +47,35 @@ def main() -> None:
     log(f"bench: assembled {sum(len(v) for v in groups.values())} windows "
         f"({len(groups)} length groups) in {time.time() - t0:.1f}s")
 
-    # one compiled solver per length group; batch = windows-in-group x scenarios
+    # One compiled solver per length group; batch = windows-in-group x
+    # scenarios.  Constant problem data (q/l/u per window) is placed on
+    # device once at prep, like the LP structure itself; the Monte-Carlo
+    # price sweep is drawn ON DEVICE each run from a fresh seed — on a
+    # remote chip, shipping a (batch x n) cost matrix over the wire costs
+    # more than the entire solve.
+    import jax.numpy as jnp
+
+    from dervet_tpu.benchlib import scenario_price_batch_device
+
     jobs = []
     for T, lps in sorted(groups.items()):
         solver = CompiledLPSolver(lps[0], PDHGOptions())
-        C = np.concatenate([
-            scenario_price_batch(lp, n_scen, seed=17) for lp in lps])
-        Q = np.repeat(np.stack([lp.q for lp in lps]), n_scen, axis=0)
-        L = np.repeat(np.stack([lp.l for lp in lps]), n_scen, axis=0)
-        U = np.repeat(np.stack([lp.u for lp in lps]), n_scen, axis=0)
-        jobs.append((T, solver, C, Q, L, U))
+        c_stack = jnp.asarray(np.stack([lp.c for lp in lps]), jnp.float32)
+        Q = jnp.repeat(jnp.asarray(np.stack([lp.q for lp in lps]),
+                                   jnp.float32), n_scen, axis=0)
+        L = jnp.repeat(jnp.asarray(np.stack([lp.l for lp in lps]),
+                                   jnp.float32), n_scen, axis=0)
+        U = jnp.repeat(jnp.asarray(np.stack([lp.u for lp in lps]),
+                                   jnp.float32), n_scen, axis=0)
+        jobs.append((T, solver, c_stack, Q, L, U))
         log(f"bench: group T={T}: {len(lps)} windows x {n_scen} scenarios "
-            f"-> batch {C.shape[0]}, n={lps[0].n}, m={lps[0].m}")
+            f"-> batch {Q.shape[0]}, n={lps[0].n}, m={lps[0].m}")
 
-    def run_all():
+    def run_all(seed):
         results = []
-        for T, solver, C, Q, L, U in jobs:
+        for gi, (T, solver, c_stack, Q, L, U) in enumerate(jobs):
+            # (w*n_scen, n) per-scenario costs, one device dispatch
+            C = scenario_price_batch_device(c_stack, n_scen, seed + gi)
             res = solver.solve(c=C, q=Q, l=L, u=U)
             results.append(res)
         # block on everything
@@ -72,12 +84,12 @@ def main() -> None:
         return results
 
     t0 = time.time()
-    run_all()
+    run_all(seed=17)
     warm = time.time() - t0
     log(f"bench: warm-up (incl. XLA compile): {warm:.1f}s")
 
     t0 = time.time()
-    results = run_all()
+    results = run_all(seed=31)
     elapsed = time.time() - t0
 
     n_total = sum(int(np.asarray(r.converged).size) for r in results)

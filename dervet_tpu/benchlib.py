@@ -11,6 +11,7 @@ batched over price scenarios.
 """
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Tuple
 
 import numpy as np
@@ -91,3 +92,38 @@ def scenario_price_batch(lp: LP, n_scenarios: int, seed: int = 0
     rng = np.random.default_rng(seed)
     mult = rng.lognormal(mean=0.0, sigma=0.15, size=(n_scenarios, lp.n))
     return np.where(lp.c[None, :] != 0.0, mult * lp.c[None, :], 0.0)
+
+
+@functools.lru_cache(maxsize=1)
+def _device_price_draw():
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def draw(c_stack, n_scen, key):
+        # c_stack: (w, n) per-window base costs -> (w * n_scen, n) draws,
+        # one fused kernel for the whole length group
+        w, n = c_stack.shape
+        keys = jax.random.split(key, w)
+        z = jax.vmap(lambda k: jax.random.normal(k, (n_scen, n),
+                                                 c_stack.dtype))(keys)
+        mult = jnp.exp(0.15 * z)                      # (w, n_scen, n)
+        c = c_stack[:, None, :]
+        out = jnp.where(c != 0.0, mult * c, 0.0)
+        return out.reshape(w * n_scen, n)
+
+    return draw
+
+
+def scenario_price_batch_device(c_stack_dev, n_scenarios: int, seed: int = 0):
+    """Device-side Monte-Carlo price draws (same distribution as
+    :func:`scenario_price_batch`) for a whole window group at once:
+    ``c_stack_dev`` is (n_windows, n) and the result is
+    (n_windows * n_scenarios, n), window-major.  On a remote accelerator
+    the host->device transfer of a (batch x n) cost matrix costs more than
+    the whole solve — generating the sweep on device from one seed per
+    group is the TPU-first shape of a Monte-Carlo run; only the seed
+    crosses the wire, in a single dispatch."""
+    import jax
+    return _device_price_draw()(c_stack_dev, n_scenarios,
+                                jax.random.PRNGKey(seed))

@@ -144,8 +144,9 @@ class CostBenefitAnalysis:
         self.proforma = self.proforma_report(ders, value_streams, results,
                                              opt_years, poi)
         self.npv = self.npv_report(self.proforma)
-        self.payback = self.payback_report(self.proforma)
         self.cost_benefit = self.cost_benefit_report(self.proforma)
+        self.payback = self.payback_report(self.proforma, self.npv,
+                                           self.cost_benefit)
 
     # ------------------------------------------------------------------
     def proforma_report(self, ders, value_streams: Dict,
@@ -241,14 +242,15 @@ class CostBenefitAnalysis:
         DERExtension.put_capital_cost_on_construction_year, :190-206)."""
         for der in ders:
             cy = der.construction_year
-            if not cy or cy < self.start_year:
+            if not cy or cy < self.start_year or cy not in proforma.index:
+                # outside the proforma's year range: leave the capital
+                # cost in the CAPEX Year row rather than deleting it
                 continue
             col = f"{der.unique_tech_id} Capital Cost"
             if col not in proforma.columns:
                 continue
             proforma[col] = 0.0
-            if cy in proforma.index:
-                proforma.loc[cy, col] = -der.get_capex()
+            proforma.loc[cy, col] = -der.get_capex()
         return proforma
 
     def _der_columns(self, der, opt_years, results) -> Dict[str, pd.Series]:
@@ -498,6 +500,13 @@ class CostBenefitAnalysis:
             out.loc[yr, f"{uid} MACRS Depreciation"] = -capex * pct / 100.0
         disregard_row = (CAPEX_ROW if start_taxing == self.start_year
                          else cy)
+        if disregard_row not in out.index:
+            # construction year outside the proforma's year range: the
+            # capital cost stayed in the CAPEX Year row (see
+            # _move_capex_to_construction_year), so disregard it there —
+            # otherwise the CAPEX row would be taxed as a loss and
+            # generate a phantom tax credit
+            disregard_row = CAPEX_ROW
         if disregard_row in out.index:
             out.loc[disregard_row,
                     f"{uid} Disregard From Taxable Income"] = capex
@@ -516,14 +525,27 @@ class CostBenefitAnalysis:
         out["Lifetime Present Value"] = total
         return pd.DataFrame(out, index=["NPV"])
 
-    def payback_report(self, proforma: pd.DataFrame) -> pd.DataFrame:
-        """Simple payback = capex / first-year net benefit; discounted
-        payback from cumulative discounted net (reference CBA.py:479-523)."""
-        capex = (-float(proforma.loc[CAPEX_ROW].drop(
-            labels=["Yearly Net Value"], errors="ignore").sum())
-            if CAPEX_ROW in proforma.index else 0.0)
+    def payback_report(self, proforma: pd.DataFrame,
+                       npv: Optional[pd.DataFrame] = None,
+                       cost_benefit: Optional[pd.DataFrame] = None
+                       ) -> pd.DataFrame:
+        """Simple payback = capital cost / first-year operating net benefit;
+        discounted payback from cumulative discounted operating net
+        (reference CBA.py:479-523 + storagevet Financial.payback_report).
+        Capital cost is summed from the Capital Cost columns wherever the
+        proforma placed them (CAPEX Year row or construction-year row);
+        Lifetime Net Present Value and Benefit-Cost Ratio restate the
+        npv/cost-benefit report totals exactly as the reference merges
+        ``self.npv['Lifetime Present Value']`` and
+        ``benefit_cost_ratio(self.cost_benefit)``."""
+        cap_cols = [c for c in proforma.columns
+                    if c.endswith(" Capital Cost")]
+        capex = (-float(proforma[cap_cols].to_numpy(dtype=float).sum())
+                 if cap_cols else 0.0)
         years = [y for y in proforma.index if y != CAPEX_ROW]
-        net = proforma.loc[years, "Yearly Net Value"].to_numpy(dtype=float)
+        op = proforma.loc[years].drop(
+            columns=cap_cols + ["Yearly Net Value"], errors="ignore")
+        net = op.sum(axis=1).to_numpy(dtype=float)
         first = net[0] if len(net) else 0.0
         payback = capex / first if first > 0 else float("nan")
         rate = self.npv_discount_rate
@@ -535,21 +557,22 @@ class CostBenefitAnalysis:
                 over = c - capex
                 dpb = (k + 1) - over / disc[k] if disc[k] else (k + 1)
                 break
-        cashflow = np.concatenate([[-capex], net])
-        rate_irr = irr(cashflow)
-        npv_total = npv_series(rate, np.concatenate([[-capex], net]))
-        benefits = np.where(net > 0, net, 0.0)
-        costs = np.where(net < 0, -net, 0.0)
-        pv_ben = npv_series(rate, np.concatenate([[0.0], benefits]))
-        pv_cost = capex + npv_series(rate, np.concatenate([[0.0], costs]))
-        bcr = pv_cost / pv_ben if pv_ben else float("nan")
+        rate_irr = irr(proforma["Yearly Net Value"].to_numpy(dtype=float))
+        if npv is None:
+            npv = self.npv_report(proforma)
+        npv_total = float(npv["Lifetime Present Value"].iloc[0])
+        cb = (cost_benefit if cost_benefit is not None
+              else self.cost_benefit_report(proforma))
+        pv_cost = float(cb.loc["Lifetime Present Value", "Cost ($)"])
+        pv_ben = float(cb.loc["Lifetime Present Value", "Benefit ($)"])
+        bcr = pv_ben / pv_cost if pv_cost else float("nan")
         return pd.DataFrame({
             "Unit": ["Years", "$", "-"],
             "Payback Period": [payback, None, None],
             "Discounted Payback Period": [dpb, None, None],
             "Lifetime Net Present Value": [None, npv_total, None],
             "Internal Rate of Return": [None, None, rate_irr],
-            "Cost-Benefit Ratio": [None, None, bcr],
+            "Benefit-Cost Ratio": [None, None, bcr],
         })
 
     def cost_benefit_report(self, proforma: pd.DataFrame) -> pd.DataFrame:

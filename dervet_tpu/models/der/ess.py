@@ -75,6 +75,9 @@ class EnergyStorage(DER):
             "ch": (g("user_ch_rated_min"), g("user_ch_rated_max")),
             "dis": (g("user_dis_rated_min"), g("user_dis_rated_max")),
         }
+        # per-window user TS limits actually applied, echoed into the
+        # output timeseries: column stem -> {window label: Series}
+        self._ts_user_limits: Dict[str, Dict[int, pd.Series]] = {}
 
     # ---------------- capacity accessors (sizing overrides later) ------
     def energy_capacity(self) -> float:
@@ -331,6 +334,16 @@ class EnergyStorage(DER):
             hi_arr = np.clip(np.nan_to_num(hi, nan=hi_def), None, hi_def) \
                 if hi is not None else hi_def
             b.set_bounds(ref, lb=lo_arr, ub=hi_arr)
+            # echo the applied limits into the output timeseries
+            # (reference ESSSizing.timeseries_report, :299-308:
+            # '<TAG>: <name> User Charge Max (kW)' etc.)
+            qty, unit = lo_col.split(": ")[1].rsplit(" ", 2)[0], \
+                ("(kWh)" if "Energy" in lo_col else "(kW)")
+            for stem, arr in ((f"User {qty} Max {unit}", hi_arr),
+                              (f"User {qty} Min {unit}", lo_arr)):
+                full = np.broadcast_to(np.asarray(arr, float), (ctx.T,))
+                self._ts_user_limits.setdefault(stem, {})[ctx.label] = \
+                    pd.Series(full, index=ctx.index)
 
     def _daily_sum_matrix(self, ctx: WindowContext) -> sp.csr_matrix:
         """(n_days, T) matrix summing dis*dt per calendar day."""
@@ -409,6 +422,9 @@ class EnergyStorage(DER):
         out[self.col("Power (kW)")] = v["dis"] - v["ch"]
         out[self.col("State of Energy (kWh)")] = v["ene"]
         out[self.col("SOC (%)")] = v["ene"] / (e_max if e_max else 1.0)
+        for stem, per_window in self._ts_user_limits.items():
+            ser = pd.concat(per_window.values()).sort_index()
+            out[self.col(stem)] = ser.reindex(out.index)
         return out
 
     def get_capex(self) -> float:

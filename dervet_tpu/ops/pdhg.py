@@ -605,11 +605,12 @@ class CompiledLPSolver:
         while True:
             limit = np.int32(min(total + self.opts.chunk_iters, max_iters))
             state = chunk(*args, self.eta, state, limit)
-            totals = np.asarray(state.total)
-            total = int(totals.max())
-            active = ~(np.asarray(state.converged)
-                       | np.asarray(state.infeasible))
-            if not active.any() or total >= max_iters:
+            # ONE tiny fused readback per chunk: a remote-device fetch costs
+            # ~100 ms of latency over the tunnel regardless of size
+            total, n_active = (int(v) for v in np.asarray(
+                _status_scalars(state.total, state.converged,
+                                state.infeasible)))
+            if n_active == 0 or total >= max_iters:
                 break
         return fin(*args, state)
 
@@ -620,6 +621,14 @@ class CompiledLPSolver:
         l = jnp.broadcast_to(l, (B, self.lp.n)) if l.ndim == 1 else l
         u = jnp.broadcast_to(u, (B, self.lp.n)) if u.ndim == 1 else u
         return c, q, l, u
+
+
+@jax.jit
+def _status_scalars(total, converged, infeasible):
+    """[max total iters, number of still-active instances] as one array."""
+    active = ~(converged | infeasible)
+    return jnp.stack([jnp.max(total).astype(jnp.int32),
+                      jnp.sum(active).astype(jnp.int32)])
 
 
 def solve_lp(lp: LP, opts: Optional[PDHGOptions] = None) -> PDHGResult:

@@ -1,0 +1,71 @@
+"""Micro-profile of the PDHG chunk loop on the bench's largest group.
+
+Times (a) one full run_chunk of `chunk_iters` on the T=744 group at the
+bench batch size, (b) a bare batched matvec pair at the same shapes, to
+separate MXU GEMM cost from elementwise/state overhead.
+"""
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dervet_tpu.benchlib import build_window_lps, scenario_price_batch, synthetic_case
+from dervet_tpu.ops.pdhg import CompiledLPSolver, PDHGOptions, op_matvec, op_rmatvec
+
+B = int(os.environ.get("PROF_B", "7000"))
+ITERS = int(os.environ.get("PROF_ITERS", "1024"))
+
+case = synthetic_case()
+scen, groups = build_window_lps(case)
+T = max(groups)
+lp = groups[T][0]
+print(f"group T={T}: n={lp.n} m={lp.m}, batch {B}", file=sys.stderr)
+
+opts = PDHGOptions(chunk_iters=ITERS)
+solver = CompiledLPSolver(lp, opts)
+C = scenario_price_batch(lp, B)
+c, q, l, u = solver.batch_data(B, *solver._data(C, None, None, None))
+args = (solver.op, c, q, l, u, solver.dr, solver.dc)
+
+state = solver._jit_init_b(*args)
+jax.block_until_ready(state.x)
+
+# warm-up compile
+st = solver._jit_chunk_b(*args, solver.eta, state, np.int32(ITERS))
+jax.block_until_ready(st.x)
+
+t0 = time.time()
+st2 = solver._jit_chunk_b(*args, solver.eta, st, np.int32(2 * ITERS))
+jax.block_until_ready(st2.x)
+dt_chunk = time.time() - t0
+per_iter = dt_chunk / ITERS
+print(f"chunk: {dt_chunk:.3f}s for {ITERS} iters -> {per_iter*1e3:.3f} ms/iter")
+
+# bare matvec pair at same shapes
+x = jnp.asarray(np.random.rand(B, lp.n), jnp.float32)
+prec = opts.precision
+
+
+@jax.jit
+def mv_pair(x):
+    y = jax.vmap(lambda v: op_matvec(solver.op, v, prec))(x)
+    return jax.vmap(lambda w: op_rmatvec(solver.op, w, prec))(y)
+
+
+r = mv_pair(x)
+jax.block_until_ready(r)
+t0 = time.time()
+N = 50
+for _ in range(N):
+    x = mv_pair(x)
+jax.block_until_ready(x)
+per_mv = (time.time() - t0) / N
+print(f"bare matvec+rmatvec: {per_mv*1e3:.3f} ms/pair "
+      f"({100*per_mv/per_iter:.0f}% of loop iter)")
+flops = 2 * 2 * B * lp.m * lp.n
+print(f"GEMM tflops at that rate: {flops/per_mv/1e12:.1f}")

@@ -52,3 +52,45 @@ class TestProformaWithoutDegradation:
     def test_opt_years_equal_without_degradation(self, proforma):
         ec = proforma["Avoided Energy Charge"]
         assert ec[2017] == pytest.approx(ec[2022], rel=1e-6)
+
+
+class TestPaybackMetrics:
+    """Payback metrics stay meaningful when capex moves to the
+    construction-year row and the CAPEX Year row is dropped (reference
+    computes capex from the technologies, CBA.py:479-523)."""
+
+    @pytest.fixture(scope="class")
+    def result(self):
+        d = DERVET(MP / "041-no_Degradation_Test_MP.csv", base_path=REF)
+        return d.solve(backend="cpu").instances[0]
+
+    def test_capital_cost_counted_once(self, result):
+        pf = result.proforma_df
+        ders = result.scenario.ders
+        cap_cols = [c for c in pf.columns if c.endswith(" Capital Cost")]
+        total_cap = float(pf[cap_cols].to_numpy().sum())
+        expected = -sum(d.get_capex() for d in ders)
+        assert total_cap == pytest.approx(expected, rel=1e-9)
+        # and each column carries its capex in exactly one row
+        for col in cap_cols:
+            assert int((pf[col] != 0).sum()) == 1
+
+    def test_payback_not_nan_with_positive_net(self, result):
+        pb = result.payback_df
+        row = pb.set_index("Unit")
+        payback = float(row.loc["Years", "Payback Period"])
+        assert np.isfinite(payback) and payback > 0
+
+    def test_lifetime_npv_matches_npv_report(self, result):
+        pb = result.payback_df.set_index("Unit")
+        lifetime = float(pb.loc["$", "Lifetime Net Present Value"])
+        assert lifetime == pytest.approx(
+            float(result.npv_df["Lifetime Present Value"].iloc[0]), rel=1e-9)
+
+    def test_benefit_cost_ratio_is_benefit_over_cost(self, result):
+        pb = result.payback_df.set_index("Unit")
+        cb = result.cost_benefit_df
+        ben = float(cb.loc["Lifetime Present Value", "Benefit ($)"])
+        cost = float(cb.loc["Lifetime Present Value", "Cost ($)"])
+        assert float(pb.loc["-", "Benefit-Cost Ratio"]) == pytest.approx(
+            ben / cost, rel=1e-9)

@@ -72,6 +72,20 @@ def test_soe_reservation(solved):
         assert (ene >= bat.operational_min_energy() + up_reserved - 1e-3).all()
 
 
+def test_user_ts_constraint_columns(solved):
+    """Mirrors the reference's test_technology_features.py:51-60: the
+    applied user TS limits are echoed into the output timeseries and the
+    optimized dispatch respects them."""
+    inst = solved.instances[0]
+    ts = inst.time_series_data
+    bat = next(d for d in inst.scenario.ders if d.tag == "Battery")
+    dis_max = ts[bat.col("User Discharge Max (kW)")]
+    ch_max = ts[bat.col("User Charge Max (kW)")]
+    assert not dis_max.isna().any() and not ch_max.isna().any()
+    assert np.all(ts[bat.col("Discharge (kW)")] <= dis_max + 1e-6)
+    assert np.all(ts[bat.col("Charge (kW)")] <= ch_max + 1e-6)
+
+
 def test_market_revenue_in_proforma(solved):
     inst = solved.instances[0]
     pf = inst.proforma_df
