@@ -39,6 +39,8 @@ class ElectricVehicle1(DER):
         self.ene_target = g("ene_target")
         self.plugin_time = int(g("plugin_time"))
         self.plugout_time = int(g("plugout_time"))
+        self.ccost = g("ccost")
+        self.fixed_om = g("fixed_om")
 
     def _plugged_mask(self, index: pd.DatetimeIndex) -> np.ndarray:
         hours = index.hour.to_numpy()
@@ -85,14 +87,54 @@ class ElectricVehicle1(DER):
     def power_terms(self, b: LPBuilder) -> List[Tuple[VarRef, float]]:
         return [(b[self.vname("ch")], -1.0)]
 
+    def market_headroom(self, b: LPBuilder, direction: str):
+        """Up: cut charging down to ch_min; down: raise charging to rated
+        (reference ElectricVehicles.py:151-176
+        get_charge_up/down_schedule)."""
+        ch = b[self.vname("ch")]
+        if direction == "up":
+            return [(ch, 1.0)], -self.ch_min_rated
+        return [(ch, -1.0)], self.ch_max_rated
+
+    def get_capex(self) -> float:
+        return self.ccost
+
+    def proforma_report(self, opt_years, apply_inflation_rate_func=None,
+                        fill_forward_func=None):
+        """Fixed O&M per analysis year (reference
+        ElectricVehicles.py:321-348)."""
+        uid = self.unique_tech_id
+        return pd.DataFrame(
+            {f"{uid} Fixed O&M Cost": {pd.Period(yr, freq="Y"): -self.fixed_om
+                                       for yr in opt_years}})
+
     def load_series(self):
         v = self.variables_df
         return v["ch"].to_numpy() if v is not None and "ch" in v else None
 
     def timeseries_report(self) -> pd.DataFrame:
+        """Charge/Power plus the implied SOE: cumulative charged energy
+        within each plug-in session, resetting to 0 at plug-in (reference
+        ElectricVehicles.py:299-317 reports ene/uene/uch; the reference's
+        SOE starts each session at 0 and must reach ene_target)."""
         v = self.variables_df
         out = pd.DataFrame(index=v.index)
-        out[self.col("Charge (kW)")] = v["ch"]
+        ch = v["ch"].to_numpy()
+        out[self.col("Charge (kW)")] = ch
+        out[self.col("Power (kW)")] = -ch
+        plugged = self._plugged_mask(v.index)
+        soe = np.zeros(len(ch))
+        acc = 0.0
+        prev = False
+        for t, p in enumerate(plugged):
+            if p and not prev:
+                acc = 0.0
+            acc = acc + ch[t] * self.dt if p else 0.0
+            soe[t] = acc
+            prev = p
+        out[self.col("State of Energy (kWh)")] = soe
+        out[self.col("Energy Option (kWh)")] = 0.0
+        out[self.col("Charge Option (kW)")] = 0.0
         return out
 
 
@@ -108,6 +150,11 @@ class ElectricVehicle2(DER):
         g = lambda k, d=0.0: float(keys.get(k, d) or 0.0)
         self.max_load_ctrl = g("max_load_ctrl") / 100.0
         self.lost_load_cost = g("lost_load_cost")
+        self.ccost = g("ccost")
+        self.fixed_om = g("fixed_om")
+        # current window's baseline, stashed by build() for the POI's
+        # market-headroom rows (built right after the DERs each window)
+        self._cur_base: Optional[np.ndarray] = None
         self.datasets = datasets
         if datasets is None or datasets.time_series is None:
             raise TimeseriesDataError("ElectricVehicle2 requires a time series "
@@ -121,19 +168,47 @@ class ElectricVehicle2(DER):
 
     def build(self, b: LPBuilder, ctx: WindowContext) -> None:
         base = self.baseline(ctx)
+        self._cur_base = base
         lb = (1.0 - self.max_load_ctrl) * base
         ch = b.var(self.vname("ch"), ctx.T, lb=lb, ub=base)
-        # lost-load cost on shed baseline energy: cost*(base-ch)*dt; the
-        # constant part goes to c0 for faithful objective reporting
+        # lost-load cost on shed baseline power: cost * sum(base - ch) —
+        # the reference sums POWER, without a dt factor
+        # (ElectricVehicles.py:495-513 objective_function); the constant
+        # part goes to c0 for faithful objective reporting
         if self.lost_load_cost:
-            b.add_cost(ch, -self.lost_load_cost * ctx.dt * ctx.annuity_scalar,
+            b.add_cost(ch, -self.lost_load_cost,
                        label=f"{self.name} lost_load")
-            b.add_const_cost(float(np.sum(base)) * self.lost_load_cost
-                             * ctx.dt * ctx.annuity_scalar,
+            b.add_const_cost(float(np.sum(base)) * self.lost_load_cost,
                              label=f"{self.name} lost_load")
+        if self.fixed_om:
+            # the reference's objective carries the fixed O&M constant per
+            # window (ElectricVehicles.py:510)
+            b.add_const_cost(self.fixed_om * ctx.annuity_scalar,
+                             label=f"{self.name} fixed_om")
 
     def power_terms(self, b: LPBuilder) -> List[Tuple[VarRef, float]]:
         return [(b[self.vname("ch")], -1.0)]
+
+    def market_headroom(self, b: LPBuilder, direction: str):
+        """Up: shed down to (1-max_load_ctrl)*baseline; down: restore up
+        to the baseline (reference ElectricVehicles.py:467-493)."""
+        ch = b[self.vname("ch")]
+        base = self._cur_base if self._cur_base is not None else 0.0
+        if direction == "up":
+            return [(ch, 1.0)], -(1.0 - self.max_load_ctrl) * base
+        return [(ch, -1.0)], base
+
+    def get_capex(self) -> float:
+        return self.ccost
+
+    def proforma_report(self, opt_years, apply_inflation_rate_func=None,
+                        fill_forward_func=None):
+        """Fixed O&M per analysis year (reference
+        ElectricVehicles.py:562-589)."""
+        uid = self.unique_tech_id
+        return pd.DataFrame(
+            {f"{uid} Fixed O&M Cost": {pd.Period(yr, freq="Y"): -self.fixed_om
+                                       for yr in opt_years}})
 
     def load_series(self):
         v = self.variables_df
@@ -143,4 +218,5 @@ class ElectricVehicle2(DER):
         v = self.variables_df
         out = pd.DataFrame(index=v.index)
         out[self.col("Charge (kW)")] = v["ch"]
+        out[self.col("Power (kW)")] = -v["ch"]
         return out

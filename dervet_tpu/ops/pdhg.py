@@ -101,7 +101,31 @@ class EllOp(NamedTuple):
     dense_blk: jax.Array     # (m, kd)
 
 
+class ShardRowOp(NamedTuple):
+    """Row(constraint)-sharded operator for ONE large LP spread over a
+    device mesh axis (time-axis "sequence parallelism": dispatch-LP rows
+    are time-indexed, so sharding rows shards the year).  ``inner`` holds
+    this device's row block; ``eq_mask`` its rows' equality flags.  The
+    matvec K@x is purely local (x replicated); the rmatvec K^T@y psums
+    partial gradients across the axis (SURVEY.md §2.10 TP/SP row)."""
+    inner: "MatOp"
+    eq_mask: jax.Array       # (m_local,) bool
+
+
 MatOp = Union[DenseOp, EllOp]
+
+
+def _inner_op(op) -> MatOp:
+    return op.inner if isinstance(op, ShardRowOp) else op
+
+
+def _psum_if(v, axis):
+    return jax.lax.psum(v, axis) if axis else v
+
+
+def _rnorm(v, axis):
+    """2-norm of a vector sharded over ``axis`` (None = unsharded)."""
+    return jnp.sqrt(_psum_if(jnp.sum(v * v), axis))
 
 
 def _csr_to_ell(K) -> tuple[np.ndarray, np.ndarray]:
@@ -249,22 +273,24 @@ class _State(NamedTuple):
 # Core solver on the *scaled* problem, structured for jit + vmap
 # ---------------------------------------------------------------------------
 
-def _kkt_terms(op, x, y, c, q, l, u, eq_mask, dr, dc, prec):
+def _kkt_terms(op, x, y, c, q, l, u, eq_mask, dr, dc, prec, axis=None):
     """Residuals/objectives of the UNSCALED problem given scaled iterates.
 
     x_unscaled = dc * x, y_unscaled = dr * y; K = D_r^-1 Kh D_c^-1.
+    Under a ShardRowOp all m(row)-dimension reductions psum over ``axis``;
+    n-dimension quantities are replicated and need no collectives.
     """
     xu = dc * x
     yu = dr * y
-    Kx = op_matvec(op, x, prec) / dr        # = K @ xu
-    KTy = op_rmatvec(op, y, prec) / dc      # = K.T @ yu
+    Kx = op_matvec(_inner_op(op), x, prec) / dr        # = K @ xu (local rows)
+    KTy = _psum_if(op_rmatvec(_inner_op(op), y, prec), axis) / dc  # = K.T @ yu
     r = q - Kx
     viol = jnp.where(eq_mask, jnp.abs(r), jnp.maximum(r, 0.0))
     # PDLP termination uses 2-norm residuals vs eps_rel * ||q||_2 (see
     # PAPERS.md PDLP; OR-tools termination_criteria) — an inf-norm test at
     # kW scale is far stricter than the published algorithm and stalls on
     # degenerate epigraph rows (e.g. demand-charge peaks)
-    prim_res = jnp.linalg.norm(viol) if viol.size else jnp.asarray(0.0, x.dtype)
+    prim_res = _rnorm(viol, axis) if viol.size else jnp.asarray(0.0, x.dtype)
     lam = c - KTy                           # reduced costs
     lam_pos = jnp.maximum(lam, 0.0)
     lam_neg = jnp.minimum(lam, 0.0)
@@ -274,8 +300,9 @@ def _kkt_terms(op, x, y, c, q, l, u, eq_mask, dr, dc, prec):
     dres_vec = jnp.where(l_fin, 0.0, lam_pos) + jnp.where(u_fin, 0.0, -lam_neg)
     dual_res = jnp.linalg.norm(dres_vec) if dres_vec.size else jnp.asarray(0.0, x.dtype)
     pobj = c @ xu
-    dobj = q @ yu + jnp.sum(jnp.where(l_fin, lam_pos * l, 0.0)
-                            + jnp.where(u_fin, lam_neg * u, 0.0))
+    dobj = _psum_if(jnp.sum(q * yu), axis) \
+        + jnp.sum(jnp.where(l_fin, lam_pos * l, 0.0)
+                  + jnp.where(u_fin, lam_neg * u, 0.0))
     gap = jnp.abs(pobj - dobj)
     return prim_res, dual_res, gap, pobj, dobj
 
@@ -287,7 +314,7 @@ def _converged(prim_res, dual_res, gap, pobj, dobj, q_norm, c_norm, opts):
     return ok_p & ok_d & ok_g
 
 
-def _farkas_gap(op, y, q, l, u, eq_mask, dr, dc, prec, dtype):
+def _farkas_gap(op, y, q, l, u, eq_mask, dr, dc, prec, dtype, axis=None):
     """Primal-infeasibility certificate quality of the dual direction ``y``.
 
     The primal (min c@x : Kx - q in {0}^eq x R+^ineq, l<=x<=u) is infeasible
@@ -297,9 +324,10 @@ def _farkas_gap(op, y, q, l, u, eq_mask, dr, dc, prec, dtype):
     valid when gap > eps and ray_violation <= eps.
     """
     yu = dr * y
-    ynorm = jnp.linalg.norm(yu)
+    ynorm = _rnorm(yu, axis)
     yhat = yu / jnp.maximum(ynorm, jnp.asarray(1e-12, dtype))
-    KTy = op_rmatvec(op, y, prec) / dc / jnp.maximum(ynorm, 1e-12)  # K^T yhat
+    KTy = _psum_if(op_rmatvec(_inner_op(op), y, prec), axis) \
+        / dc / jnp.maximum(ynorm, 1e-12)  # K^T yhat
     pos = jnp.maximum(KTy, 0.0)
     neg = jnp.minimum(KTy, 0.0)
     l_fin = jnp.isfinite(l)
@@ -309,12 +337,19 @@ def _farkas_gap(op, y, q, l, u, eq_mask, dr, dc, prec, dtype):
     ray_viol = jnp.sum(jnp.where(u_fin, 0.0, pos) - jnp.where(l_fin, 0.0, neg))
     box_max = jnp.sum(jnp.where(u_fin, pos * u, 0.0)
                       + jnp.where(l_fin, neg * l, 0.0))
-    gap = q @ yhat - box_max
+    gap = _psum_if(jnp.sum(q * yhat), axis) - box_max
     return gap, ray_viol, ynorm
 
 
-def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
-    """Build the jittable scaled-space solve(op, c, q, l, u, dr, dc, eta)."""
+def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int, axis=None):
+    """Build the jittable scaled-space solve(op, c, q, l, u, dr, dc, eta).
+
+    With ``axis`` set, the solve runs INSIDE a ``shard_map`` over that mesh
+    axis on a row-sharded single LP (op is a ShardRowOp, ``m`` is the LOCAL
+    row count, ``q``/``dr`` are row-sharded, ``c/l/u/dc`` and every x-space
+    quantity replicated): K@x stays local, K^T@y and all row-space
+    reductions psum over the axis.
+    """
 
     prec = opts.precision
 
@@ -324,21 +359,22 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
         x, y, x_sum, y_sum = carry
         tau = eta / omega
         sigma = eta * omega
-        grad = c - op_rmatvec(op, y, prec)
+        grad = c - _psum_if(op_rmatvec(_inner_op(op), y, prec), axis)
         x1 = jnp.clip(x - tau * grad, l, u)
-        y1 = y + sigma * (q - op_matvec(op, 2.0 * x1 - x, prec))
+        y1 = y + sigma * (q - op_matvec(_inner_op(op), 2.0 * x1 - x, prec))
         y1 = jnp.where(eq_mask, y1, jnp.maximum(y1, 0.0))
         return (x1, y1, x_sum + x1, y_sum + y1), None
 
     def _context(op, c, q, l, u, dr, dc):
         """Scaled problem data shared by init/chunk/finalize."""
         dtype = opts.dtype
-        eq_mask = jnp.arange(m) < n_eq
+        eq_mask = (op.eq_mask if isinstance(op, ShardRowOp)
+                   else jnp.arange(m) < n_eq)
         c_s = (c * dc).astype(dtype)
         q_s = (q * dr).astype(dtype)
         l_s = jnp.where(jnp.isfinite(l), l / dc, l).astype(dtype)
         u_s = jnp.where(jnp.isfinite(u), u / dc, u).astype(dtype)
-        q_norm = jnp.linalg.norm(q).astype(dtype) if m else jnp.asarray(0.0, dtype)
+        q_norm = _rnorm(q, axis).astype(dtype) if m else jnp.asarray(0.0, dtype)
         c_norm = jnp.linalg.norm(c).astype(dtype) if n else jnp.asarray(0.0, dtype)
         # zero scalar *derived from the problem data* so that, under
         # shard_map, every loop-carried value inherits the data's
@@ -352,7 +388,7 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
         # space (PDLP's initialization) — battery LPs have tiny $-valued
         # duals against large kW/kWh primals, so omega << 1 is typical
         c2 = jnp.linalg.norm(c_s)
-        q2 = jnp.linalg.norm(q_s)
+        q2 = _rnorm(q_s, axis)
         omega0 = jnp.where((c2 > 0) & (q2 > 0), c2 / jnp.maximum(q2, 1e-12),
                            1.0).astype(dtype)
         return dict(dtype=dtype, eq_mask=eq_mask, c_s=c_s, q_s=q_s, l_s=l_s,
@@ -399,7 +435,7 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
 
         def mu_of(x, y):
             pr, dr_, gp, po, do = _kkt_terms(op, x, y, c_us, q_us, l_us, u_us,
-                                             eq_mask, dr, dc, prec)
+                                             eq_mask, dr, dc, prec, axis)
             denom = 1.0 + jnp.abs(po) + jnp.abs(do)
             return jnp.sqrt(pr * pr + dr_ * dr_ + (gp / denom) ** 2), (pr, dr_, gp, po, do)
 
@@ -430,7 +466,7 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
 
             # primal-infeasibility certificate on the current dual direction
             fk_gap, fk_viol, ynorm = _farkas_gap(
-                op, y, q_us, l_us, u_us, eq_mask, dr, dc, prec, dtype)
+                op, y, q_us, l_us, u_us, eq_mask, dr, dc, prec, dtype, axis)
             scale_ref = 1.0 + q_norm
             cert = ((fk_gap > opts.eps_infeas * scale_ref)
                     & (fk_viol <= opts.eps_infeas * scale_ref)
@@ -446,7 +482,7 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
             )
             # primal weight update on restart
             dx = jnp.linalg.norm(x_cand - s.x_restart)
-            dy = jnp.linalg.norm(y_cand - s.y_restart)
+            dy = _rnorm(y_cand - s.y_restart, axis)
             theta = opts.primal_weight_smoothing
             new_omega = jnp.where(
                 (dx > 1e-10) & (dy > 1e-10),
@@ -488,7 +524,7 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int):
         y_out = jnp.where(final.converged, final.done_y, final.y)
         pr, dr_, gp, po, do = _kkt_terms(
             op, x_out, y_out, t["c_us"], t["q_us"], t["l_us"], t["u_us"],
-            t["eq_mask"], dr, dc, prec)
+            t["eq_mask"], dr, dc, prec, axis)
         f = opts.inaccurate_factor
         loose = dataclasses.replace(opts, eps_abs=opts.eps_abs * f,
                                     eps_rel=opts.eps_rel * f)

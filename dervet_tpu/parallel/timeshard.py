@@ -1,0 +1,229 @@
+"""Time-axis (row) sharding of ONE large dispatch LP over a device mesh.
+
+The scenario axis (``parallel/mesh.py``) is the workhorse scale-out axis;
+this module covers the orthogonal case SURVEY.md §2.10 commits to under
+TP/SP: a *single* LP too long for comfortable single-chip iteration —
+e.g. a 5-minute-resolution year window (T=105,120 steps, n≈420k vars) —
+sharded over the mesh the way sequence parallelism shards a long context.
+
+Dispatch-LP rows are time-indexed (SOE evolution, power balance, market
+headroom per step), so sharding constraint ROWS shards the year:
+
+* each device owns a contiguous row block (its slice of the year) as an
+  ELLPACK table, plus that block's transpose, dual slice ``y``, row
+  scaling ``d_r`` and rhs ``q``;
+* the primal ``x`` (and everything n-dimensional) is replicated — for a
+  dispatch LP n ≈ 4T floats, a few MB at 5-min resolution: cheap to
+  replicate, so K@x needs NO communication at all;
+* the only collectives per iteration are one ``psum`` of the partial
+  gradients K^T@y (the all-to-all of this "sequence parallelism") and
+  scalar ``psum``s for norms/termination — both ride ICI.
+
+The PDHG algorithm itself is the SAME code as the single-chip solver:
+``ops/pdhg._make_solver(axis=...)`` swaps every row-space reduction for a
+psum (see ShardRowOp there), so restarts, primal-weight updates,
+infeasibility certificates and termination behave identically — a
+sharded solve returns bit-comparable results to the unsharded one up to
+f32 reduction order.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.lp import LP
+from ..ops.pdhg import (EllOp, PDHGOptions, PDHGResult, ShardRowOp, _State,
+                        _csr_to_ell, _make_solver, op_matvec, op_rmatvec,
+                        ruiz_scaling)
+
+AXIS = "time"
+
+
+def time_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the time(row) axis."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devs)} "
+                f"(set XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                f"for CPU testing)")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (AXIS,))
+
+
+def _block_ell(K_csr, row_lo: int, row_hi: int, width: int):
+    """One row block as fixed-width ELLPACK (padded to ``width``)."""
+    blk = K_csr[row_lo:row_hi]
+    d, c = _csr_to_ell(blk)
+    pad = width - d.shape[1]
+    if pad > 0:
+        d = np.pad(d, [(0, 0), (0, pad)])
+        c = np.pad(c, [(0, 0), (0, pad)])
+    return d, c
+
+
+class TimeShardedLPSolver:
+    """Row-sharded PDHG for one large LP on a 1-D mesh.
+
+    Usage::
+
+        mesh = time_mesh(8)
+        res = TimeShardedLPSolver(lp, mesh).solve()
+
+    ``res`` is a plain :class:`PDHGResult` for the ORIGINAL (unpadded)
+    problem. Dense-column splitting is not used on this path (size/epigraph
+    variables appear in sizing LPs, which are small and batch on the
+    scenario axis instead); rows are zero-padded to a device multiple.
+    """
+
+    def __init__(self, lp: LP, mesh: Mesh, opts: Optional[PDHGOptions] = None):
+        self.opts = opts or PDHGOptions()
+        self.lp = lp
+        self.mesh = mesh
+        dtype = self.opts.dtype
+        D = int(mesh.devices.size)
+        m, n = lp.m, lp.n
+
+        d_r, d_c = ruiz_scaling(lp.K, self.opts.ruiz_iters)
+        Kh = lp.K.multiply(d_r[:, None]).multiply(d_c[None, :]).tocsr()
+
+        # pad rows to a device multiple with zero rows (q=0, inequality:
+        # the padded dual stays pinned at 0)
+        m_loc = (m + D - 1) // D
+        m_pad = m_loc * D
+        self.m_loc, self.m_pad = m_loc, m_pad
+
+        # per-block ELL tables at a common width, stacked on the row axis
+        widths, widths_t = [], []
+        KhT = Kh.T.tocsr()  # (n, m)
+        for b in range(D):
+            lo, hi = b * m_loc, min((b + 1) * m_loc, m)
+            cnt = np.diff(Kh[lo:hi].indptr) if hi > lo else np.array([0])
+            widths.append(int(cnt.max()) if cnt.size else 0)
+            cntt = np.diff(KhT[:, lo:hi].tocsr().indptr)
+            widths_t.append(int(cntt.max()) if cntt.size else 0)
+        k = max(max(widths), 1)
+        kt = max(max(widths_t), 1)
+
+        data = np.zeros((m_pad, k), np.float64)
+        cols = np.zeros((m_pad, k), np.int32)
+        data_t = np.zeros((D * n, kt), np.float64)
+        cols_t = np.zeros((D * n, kt), np.int32)
+        for b in range(D):
+            lo, hi = b * m_loc, min((b + 1) * m_loc, m)
+            if hi <= lo:
+                continue
+            d, c = _block_ell(Kh, lo, hi, k)
+            data[b * m_loc:b * m_loc + (hi - lo)] = d
+            cols[b * m_loc:b * m_loc + (hi - lo)] = c
+            # transpose block: (n, m_local), column ids LOCAL to the block
+            dt, ct = _block_ell(KhT[:, lo:hi].tocsr(), 0, n, kt)
+            data_t[b * n:(b + 1) * n] = dt
+            cols_t[b * n:(b + 1) * n] = ct
+
+        eq_mask = np.zeros(m_pad, bool)
+        eq_mask[:lp.n_eq] = True
+
+        empty_idx = jnp.zeros((0,), jnp.int32)
+        empty_blk = jnp.zeros((m_pad, 0), dtype)
+        self.op = ShardRowOp(
+            inner=EllOp(data=jnp.asarray(data, dtype),
+                        cols=jnp.asarray(cols),
+                        data_t=jnp.asarray(data_t, dtype),
+                        cols_t=jnp.asarray(cols_t),
+                        dense_idx=empty_idx, dense_blk=empty_blk),
+            eq_mask=jnp.asarray(eq_mask))
+        self.dr = jnp.asarray(np.pad(d_r, (0, m_pad - m),
+                                     constant_values=1.0), dtype)
+        self.dc = jnp.asarray(d_c, dtype)
+        self.q = jnp.asarray(np.pad(lp.q, (0, m_pad - m)), dtype)
+        self.c = jnp.asarray(lp.c, dtype)
+        self.l = jnp.asarray(lp.l, dtype)
+        self.u = jnp.asarray(lp.u, dtype)
+
+        solve = _make_solver(self.opts, m_loc, n, lp.n_eq, axis=AXIS)
+
+        # sharding specs: row-space sharded, x-space + scalars replicated
+        op_spec = ShardRowOp(
+            inner=EllOp(data=P(AXIS), cols=P(AXIS), data_t=P(AXIS),
+                        cols_t=P(AXIS), dense_idx=P(), dense_blk=P(AXIS)),
+            eq_mask=P(AXIS))
+
+        # step size via SHARDED power iteration — the whole point of this
+        # path is that no single device ever holds the full operator
+        prec = self.opts.precision
+        n_pow = self.opts.power_iters
+
+        def _power(op, v0):
+            def piter(v, _):
+                w = jax.lax.psum(
+                    op_rmatvec(op.inner, op_matvec(op.inner, v, prec), prec),
+                    AXIS)
+                nw = jnp.linalg.norm(w)
+                return w / jnp.maximum(nw, 1e-30), nw
+
+            _, norms = jax.lax.scan(piter, v0, None, length=n_pow)
+            return norms[-1]
+
+        v0 = np.random.default_rng(0).standard_normal(n)
+        v0 = jnp.asarray(v0 / np.linalg.norm(v0), dtype)
+        sig2 = jax.jit(jax.shard_map(
+            _power, mesh=mesh, in_specs=(op_spec, P()), out_specs=P(),
+            check_vma=False))(self.op, v0)
+        sigma_max = float(jnp.sqrt(sig2))
+        self.eta = jnp.asarray(
+            self.opts.step_size_safety / max(sigma_max, 1e-12), dtype)
+        row, rep = P(AXIS), P()
+        state_spec = _State(
+            x=rep, y=row, x_sum=rep, y_sum=row, inner=rep, total=rep,
+            omega=rep, x_restart=rep, y_restart=row, mu_restart=rep,
+            mu_prev=rep, converged=rep, done_x=rep, done_y=row,
+            iters_at_conv=rep, infeas_streak=rep, infeasible=rep)
+        res_spec = PDHGResult(x=rep, y=row, obj=rep, converged=rep,
+                              iters=rep, prim_res=rep, gap=rep, status=rep)
+        data_specs = (op_spec, rep, row, rep, rep, row, rep)
+
+        # every row-space reduction inside is an explicit psum, so outputs
+        # declared replicated ARE replicated; vma tracking cannot see that
+        # through the while_loop carries, hence check_vma=False
+        self._init = jax.jit(jax.shard_map(
+            solve.init_state, mesh=mesh, in_specs=data_specs,
+            out_specs=state_spec, check_vma=False))
+        self._chunk = jax.jit(jax.shard_map(
+            solve.run_chunk, mesh=mesh,
+            in_specs=data_specs + (rep, state_spec, rep),
+            out_specs=state_spec, check_vma=False))
+        self._fin = jax.jit(jax.shard_map(
+            solve.finalize, mesh=mesh, in_specs=data_specs + (state_spec,),
+            out_specs=res_spec, check_vma=False))
+
+    def solve(self) -> PDHGResult:
+        """Host-chunked sharded solve (same driver shape as the single-chip
+        CompiledLPSolver._drive)."""
+        from ..ops.pdhg import _status_scalars
+
+        args = (self.op, self.c, self.q, self.l, self.u, self.dr, self.dc)
+        state = self._init(*args)
+        opts = self.opts
+        total = 0
+        while True:
+            limit = np.int32(min(total + opts.chunk_iters, opts.max_iters))
+            state = self._chunk(*args, self.eta, state, limit)
+            # one fused readback per chunk (remote fetches cost ~100 ms
+            # of latency each regardless of size)
+            total, n_active = (int(v) for v in np.asarray(
+                _status_scalars(state.total, state.converged,
+                                state.infeasible)))
+            if n_active == 0 or total >= opts.max_iters:
+                break
+        res = self._fin(*args, state)
+        # trim padded dual rows back to the original problem
+        return PDHGResult(x=res.x, y=res.y[:self.lp.m], obj=res.obj,
+                          converged=res.converged, iters=res.iters,
+                          prim_res=res.prim_res, gap=res.gap,
+                          status=res.status)

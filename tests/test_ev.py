@@ -55,3 +55,51 @@ def test_ev2_baseline_bounds():
                        "EV fleet", "1")
     assert (ch <= base + 1e-6).all()
     assert (ch >= 0.6 * base - 1e-6).all()
+
+
+def test_ev1_report_soe_and_capex():
+    case = _case_with("ElectricVehicle1", {
+        "name": "ev1", "ch_max_rated": 50, "ch_min_rated": 0,
+        "ene_target": 80, "plugin_time": 19, "plugout_time": 7,
+        "ccost": 12000, "fixed_om": 500})
+    s = MicrogridScenario(case)
+    s.optimize_problem_loop(backend="cpu")
+    ev = next(d for d in s.ders if d.tag == "ElectricVehicle1")
+    assert ev.get_capex() == 12000
+    pf = ev.proforma_report([2017])
+    assert float(pf["ELECTRICVEHICLE1: ev1 Fixed O&M Cost"].iloc[0]) == -500
+    ts = s.timeseries_results()
+    soe = ts["ELECTRICVEHICLE1: ev1 State of Energy (kWh)"]
+    assert float(soe.max()) == pytest.approx(80.0, rel=1e-3)
+    hours = soe.index.hour
+    assert (soe[(hours >= 7) & (hours < 19)] == 0).all()
+    assert (ts["ELECTRICVEHICLE1: ev1 Power (kW)"]
+            == -ts["ELECTRICVEHICLE1: ev1 Charge (kW)"]).all()
+
+
+def test_ev2_market_headroom_with_fr():
+    """EV2 participating in FR: up-award bounded by sheddable baseline
+    (reference get_charge_up/down_schedule, ElectricVehicles.py:467-493)."""
+    cases = Params.initialize(MP / "001-DA_FR_battery_month.csv",
+                              base_path=REF)
+    case = cases[0]
+    case.ders.append(("ElectricVehicle2", "1", {
+        "name": "fleet", "max_load_ctrl": 40, "lost_load_cost": 10000}))
+    rng = np.random.default_rng(5)
+    case.datasets.time_series["EV fleet/1"] = rng.uniform(
+        20, 80, len(case.datasets.time_series))
+    s = MicrogridScenario(case)
+    s.optimize_problem_loop(backend="cpu")
+    ts = s.timeseries_results()
+    assert "FR Awarded Up (kW)" in ts.columns
+    ch = ts["ELECTRICVEHICLE2: fleet Charge (kW)"].to_numpy()
+    from dervet_tpu.scenario.window import grab_column
+    base = grab_column(case.datasets.time_series.loc[ts.index],
+                       "EV fleet", "1")
+    bat = next(d for d in s.ders if d.tag == "Battery")
+    bch = ts[bat.col("Charge (kW)")].to_numpy()
+    bdis = ts[bat.col("Discharge (kW)")].to_numpy()
+    up = ts["FR Awarded Up (kW)"].to_numpy()
+    headroom = ((bat.discharge_capacity() - bdis) + bch
+                + (ch - 0.6 * base))
+    assert (up <= headroom + 1e-4).all()

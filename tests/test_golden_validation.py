@@ -152,6 +152,8 @@ class TestLoadShedding:
          "results/reliability_load_shed_wo_ls1", True),
         ("mp/Sizing/Model_Parameters_Template_DER_w_ls1.csv",
          "results/Sizing/w_ls1", False),
+        ("mp/Sizing/Model_Parameters_Template_DER_wo_ls1.csv",
+         "results/Sizing/wo_ls1", False),
     ])
     def test_size_proforma_lcpc(self, mp, golden, check_lcpc):
         inst = DERVET(LS / mp, base_path=REF).solve(
@@ -187,3 +189,16 @@ class TestUsecase1EsPvSizing:
 
     def test_lcpc_exists(self, es_pv_case):
         assert "load_coverage_prob" in es_pv_case.drill_down_dict
+
+
+def test_post_facto_reliability_with_user_constraints():
+    """Mirrors the reference's
+    test_post_facto_calculations_with_user_constraints
+    (test_reliability_module.py:128-129): post-facto reliability (no
+    active dispatch sizing) with User value-stream constraints runs and
+    produces the load-coverage-probability drill-down."""
+    inst = DERVET(REF / "test/model_params/"
+                  "Model_Parameters_Template_issue162.csv",
+                  base_path=REF).solve(backend="cpu").instances[0]
+    assert "load_coverage_prob" in inst.drill_down_dict
+    assert len(inst.time_series_data) == 8760
