@@ -113,10 +113,11 @@ class ElectricVehicle1(DER):
         return v["ch"].to_numpy() if v is not None and "ch" in v else None
 
     def timeseries_report(self) -> pd.DataFrame:
-        """Charge/Power plus the implied SOE: cumulative charged energy
-        within each plug-in session, resetting to 0 at plug-in (reference
-        ElectricVehicles.py:299-317 reports ene/uene/uch; the reference's
-        SOE starts each session at 0 and must reach ene_target)."""
+        """Charge/Power plus the implied SOE, BEGIN-of-step like the
+        reference's ``ene`` variable (ElectricVehicles.py constraints:
+        ene==0 at the plug-in step, ene[t] = ene[t-1] + dt*ch[t-1],
+        ene==ene_target at the plug-out step; unplugged steps hold the
+        last value)."""
         v = self.variables_df
         out = pd.DataFrame(index=v.index)
         ch = v["ch"].to_numpy()
@@ -128,9 +129,10 @@ class ElectricVehicle1(DER):
         prev = False
         for t, p in enumerate(plugged):
             if p and not prev:
-                acc = 0.0
-            acc = acc + ch[t] * self.dt if p else 0.0
+                acc = 0.0          # pinned to zero AT the plug-in step
             soe[t] = acc
+            if p:
+                acc += ch[t] * self.dt
             prev = p
         out[self.col("State of Energy (kWh)")] = soe
         out[self.col("Energy Option (kWh)")] = 0.0

@@ -56,17 +56,6 @@ def time_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devs), (AXIS,))
 
 
-def _block_ell(K_csr, row_lo: int, row_hi: int, width: int):
-    """One row block as fixed-width ELLPACK (padded to ``width``)."""
-    blk = K_csr[row_lo:row_hi]
-    d, c = _csr_to_ell(blk)
-    pad = width - d.shape[1]
-    if pad > 0:
-        d = np.pad(d, [(0, 0), (0, pad)])
-        c = np.pad(c, [(0, 0), (0, pad)])
-    return d, c
-
-
 class TimeShardedLPSolver:
     """Row-sharded PDHG for one large LP on a 1-D mesh.
 
@@ -98,33 +87,31 @@ class TimeShardedLPSolver:
         m_pad = m_loc * D
         self.m_loc, self.m_pad = m_loc, m_pad
 
-        # per-block ELL tables at a common width, stacked on the row axis
-        widths, widths_t = [], []
+        # per-block ELL tables, sliced ONCE per block, then padded to the
+        # max width across blocks and stacked on the row axis
+        blocks, blocks_t = [], []
         KhT = Kh.T.tocsr()  # (n, m)
         for b in range(D):
             lo, hi = b * m_loc, min((b + 1) * m_loc, m)
-            cnt = np.diff(Kh[lo:hi].indptr) if hi > lo else np.array([0])
-            widths.append(int(cnt.max()) if cnt.size else 0)
-            cntt = np.diff(KhT[:, lo:hi].tocsr().indptr)
-            widths_t.append(int(cntt.max()) if cntt.size else 0)
-        k = max(max(widths), 1)
-        kt = max(max(widths_t), 1)
+            blk = Kh[lo:hi] if hi > lo else Kh[:0]
+            # transpose block: (n, m_local), column ids LOCAL to the block
+            blkT = KhT[:, lo:hi].tocsr()
+            blocks.append(_csr_to_ell(blk))
+            blocks_t.append(_csr_to_ell(blkT))
+        k = max(max(d.shape[1] for d, _ in blocks), 1)
+        kt = max(max(d.shape[1] for d, _ in blocks_t), 1)
 
         data = np.zeros((m_pad, k), np.float64)
         cols = np.zeros((m_pad, k), np.int32)
         data_t = np.zeros((D * n, kt), np.float64)
         cols_t = np.zeros((D * n, kt), np.int32)
         for b in range(D):
-            lo, hi = b * m_loc, min((b + 1) * m_loc, m)
-            if hi <= lo:
-                continue
-            d, c = _block_ell(Kh, lo, hi, k)
-            data[b * m_loc:b * m_loc + (hi - lo)] = d
-            cols[b * m_loc:b * m_loc + (hi - lo)] = c
-            # transpose block: (n, m_local), column ids LOCAL to the block
-            dt, ct = _block_ell(KhT[:, lo:hi].tocsr(), 0, n, kt)
-            data_t[b * n:(b + 1) * n] = dt
-            cols_t[b * n:(b + 1) * n] = ct
+            d, c = blocks[b]
+            data[b * m_loc:b * m_loc + d.shape[0], :d.shape[1]] = d
+            cols[b * m_loc:b * m_loc + d.shape[0], :c.shape[1]] = c
+            dt, ct = blocks_t[b]
+            data_t[b * n:(b + 1) * n, :dt.shape[1]] = dt
+            cols_t[b * n:(b + 1) * n, :ct.shape[1]] = ct
 
         eq_mask = np.zeros(m_pad, bool)
         eq_mask[:lp.n_eq] = True

@@ -27,8 +27,8 @@ from ..models.streams.base import ValueStream
 from ..models.streams.da import DAEnergyTimeShift
 from ..ops.lp import LP, LPBuilder
 from ..ops import cpu_ref
-from ..utils.errors import (ParameterError, SolverError, TellUser,
-                            TimeseriesDataError)
+from ..utils.errors import (MonthlyDataError, ParameterError, SolverError,
+                            TellUser, TimeseriesDataError)
 from .aggregator import ServiceAggregator
 from .poi import POI
 from .window import WindowContext, group_by_length, make_windows
@@ -88,16 +88,24 @@ class MicrogridScenario:
         ts = case.datasets.time_series
         if ts is None:
             raise TimeseriesDataError("a time_series_filename is required")
-        # growth-fill optimization years the data lacks, then drop extras
-        # (reference Library.fill_extra_data/drop_extra_data surface)
-        from ..io.growth import (column_growth_rates, fill_extra_data,
-                                 fill_extra_monthly)
-        rates = column_growth_rates(self.scenario, case.streams, ts.columns)
-        ts = fill_extra_data(ts, self.opt_years, rates)
-        case.datasets.time_series = ts
+        # every user opt_year must exist in the referenced data — the
+        # reference REJECTS rather than growth-fills missing years
+        # (test_1params.py:97-124: 025 -> TimeseriesDataError, 039 ->
+        # MonthlyDataError).  io/growth.py keeps the storagevet Library
+        # fill/drop surface available to API users (deferral projections
+        # here grow load in-stream instead, models/streams/programs.py)
+        data_years = set(int(y) for y in ts.index.year.unique())
+        missing = sorted(y for y in self.opt_years if y not in data_years)
+        if missing:
+            raise TimeseriesDataError(
+                f"time series data has no rows for opt_years {missing}")
         if case.datasets.monthly is not None:
-            case.datasets.monthly = fill_extra_monthly(
-                case.datasets.monthly, self.opt_years)
+            myears = set(int(y) for y in
+                         case.datasets.monthly.index.get_level_values(0))
+            mmissing = sorted(y for y in self.opt_years if y not in myears)
+            if mmissing:
+                raise MonthlyDataError(
+                    f"monthly data has no rows for opt_years {mmissing}")
         keep = ts.index.year.isin(self.opt_years)
         ts = ts.loc[keep]
         if not len(ts):

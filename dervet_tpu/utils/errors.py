@@ -14,6 +14,10 @@ class ParameterError(Exception):
     """Invalid parameter combination discovered after load."""
 
 
+class MonthlyDataError(Exception):
+    """Monthly data missing or inconsistent with the scenario years."""
+
+
 class TimeseriesDataError(Exception):
     """Referenced time-series data is missing or inconsistent."""
 

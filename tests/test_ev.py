@@ -72,7 +72,15 @@ def test_ev1_report_soe_and_capex():
     soe = ts["ELECTRICVEHICLE1: ev1 State of Energy (kWh)"]
     assert float(soe.max()) == pytest.approx(80.0, rel=1e-3)
     hours = soe.index.hour
-    assert (soe[(hours >= 7) & (hours < 19)] == 0).all()
+    # begin-of-step convention (reference ene): 0 AT plug-in, ene_target
+    # AT plug-out, held while unplugged
+    assert (soe[hours == 19] == 0).all()
+    plugout = soe[hours == 7].to_numpy()
+    # sessions fully inside a window end at the target; the ~11 sessions
+    # truncated by a monthly-window boundary are unconstrained
+    frac_at_target = np.mean(np.isclose(plugout, 80.0, rtol=1e-3))
+    assert frac_at_target > 0.9
+    assert float(np.median(plugout)) == pytest.approx(80.0, rel=1e-3)
     assert (ts["ELECTRICVEHICLE1: ev1 Power (kW)"]
             == -ts["ELECTRICVEHICLE1: ev1 Charge (kW)"]).all()
 

@@ -9,8 +9,8 @@ from pathlib import Path
 import pytest
 
 from dervet_tpu.api import DERVET
-from dervet_tpu.utils.errors import (ModelParameterError, ParameterError,
-                                     TimeseriesDataError)
+from dervet_tpu.utils.errors import (ModelParameterError, MonthlyDataError,
+                                     ParameterError, TimeseriesDataError)
 
 REF = Path("/root/reference")
 MP = REF / "test/test_storagevet_features/model_params"
@@ -28,6 +28,9 @@ MISSING_DATA = {
 # inputs the REFERENCE expects to error (error-path fixtures)
 EXPECT_ERROR = {
     "024-DR_nan_length_prgramd_end_hour.csv": ParameterError,
+    # test_1params.py:97-124: user opt_years must exist in the data
+    "025-opt_year_more_than_timeseries_data.csv": TimeseriesDataError,
+    "039-mutli_opt_years_not_in_monthly_data.csv": MonthlyDataError,
 }
 
 

@@ -74,7 +74,8 @@ def test_sensitivity_fanout():
     (reference: test_1params.py:51-62 semantics)."""
     path = REF / "test/test_storagevet_features/model_params/009-bat_energy_sensitivity.csv"
     cases = Params.initialize(path, base_path=REF)
-    assert len(cases) > 1
+    # the reference's own count for this input (test_1params.py:51-56)
+    assert len(cases) == 4
     vals = set()
     for c in cases.values():
         bat = next(keys for tag, _, keys in c.ders if tag == "Battery")
@@ -99,3 +100,25 @@ def test_convert_value_types():
     assert convert_value("500", "string/float") == 500.0
     assert convert_value("yes", "bool") is True
     assert convert_value("nan", "bool") is False
+
+
+def test_opt_years_not_in_timeseries_data():
+    """Reference test_1params.py:97-101: an opt_year with no rows in the
+    referenced time series is REJECTED, not growth-filled."""
+    from dervet_tpu.api import DERVET
+    from dervet_tpu.utils.errors import TimeseriesDataError
+    path = (REF / "test/test_storagevet_features/model_params/"
+            "025-opt_year_more_than_timeseries_data.csv")
+    with pytest.raises(TimeseriesDataError):
+        DERVET(path, base_path=REF).solve(backend="cpu")
+
+
+def test_opt_years_not_in_monthly_data():
+    """Reference test_1params.py:117-124: an opt_year missing from the
+    monthly data raises MonthlyDataError."""
+    from dervet_tpu.api import DERVET
+    from dervet_tpu.utils.errors import MonthlyDataError
+    path = (REF / "test/test_storagevet_features/model_params/"
+            "039-mutli_opt_years_not_in_monthly_data.csv")
+    with pytest.raises(MonthlyDataError):
+        DERVET(path, base_path=REF).solve(backend="cpu")
