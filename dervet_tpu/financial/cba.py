@@ -288,7 +288,13 @@ class CostBenefitAnalysis:
                     esc = (1 + der.escalation_rate) ** \
                         (pay_year - (der.operation_year or self.start_year))
                     rep[pay_year] += -rcost * esc
-            cols[f"{uid} Replacement Costs"] = rep
+            # the reference joins the replacement report only when some
+            # failure year precedes the end year (CBA.py:355-362 +
+            # DERExtension.replacement_report:170-189 — a failure AT the
+            # end year emits no column; an earlier failure whose payment
+            # falls outside the proforma still emits an all-zero one)
+            if any(fy < self.end_year for fy in failure_years):
+                cols[f"{uid} Replacement Costs"] = rep
         base_yr = min(opt_years) if opt_years else self.start_year
         decomm = float(der.keys.get("decommissioning_cost", 0) or 0)
         dec = zero()

@@ -87,29 +87,33 @@ class TimeShardedLPSolver:
         m_pad = m_loc * D
         self.m_loc, self.m_pad = m_loc, m_pad
 
-        # per-block ELL tables, sliced ONCE per block, then padded to the
-        # max width across blocks and stacked on the row axis
+        # per-block CSR slices, sliced ONCE per block; widths from the
+        # indptr so only one block's ELL tables are alive at a time on
+        # top of the stacked output arrays
         blocks, blocks_t = [], []
         KhT = Kh.T.tocsr()  # (n, m)
         for b in range(D):
             lo, hi = b * m_loc, min((b + 1) * m_loc, m)
-            blk = Kh[lo:hi] if hi > lo else Kh[:0]
+            blocks.append(Kh[lo:hi] if hi > lo else Kh[:0])
             # transpose block: (n, m_local), column ids LOCAL to the block
-            blkT = KhT[:, lo:hi].tocsr()
-            blocks.append(_csr_to_ell(blk))
-            blocks_t.append(_csr_to_ell(blkT))
-        k = max(max(d.shape[1] for d, _ in blocks), 1)
-        kt = max(max(d.shape[1] for d, _ in blocks_t), 1)
+            blocks_t.append(KhT[:, lo:hi].tocsr())
+
+        def _max_width(csr):
+            counts = np.diff(csr.indptr)
+            return int(counts.max()) if counts.size else 0
+
+        k = max(max(_max_width(b) for b in blocks), 1)
+        kt = max(max(_max_width(b) for b in blocks_t), 1)
 
         data = np.zeros((m_pad, k), np.float64)
         cols = np.zeros((m_pad, k), np.int32)
         data_t = np.zeros((D * n, kt), np.float64)
         cols_t = np.zeros((D * n, kt), np.int32)
         for b in range(D):
-            d, c = blocks[b]
+            d, c = _csr_to_ell(blocks[b])
             data[b * m_loc:b * m_loc + d.shape[0], :d.shape[1]] = d
             cols[b * m_loc:b * m_loc + d.shape[0], :c.shape[1]] = c
-            dt, ct = blocks_t[b]
+            dt, ct = _csr_to_ell(blocks_t[b])
             data_t[b * n:(b + 1) * n, :dt.shape[1]] = dt
             cols_t[b * n:(b + 1) * n, :ct.shape[1]] = ct
 

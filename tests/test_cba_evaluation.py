@@ -76,3 +76,43 @@ def test_catch_wrong_length():
 def test_monthly_evaluation_runs():
     d = DERVET(DIR / "005-cba_monthly_timseries.csv", base_path=REF)
     assert d.solve(backend="cpu").instances
+
+
+CBA_MP = REF / "test/test_cba_validation/model_params"
+
+
+class TestLifetimeHorizons:
+    """Mirrors the reference's active lifetime-horizon assertions
+    (test_cba_validation/test_cba.py:127-229): with analysis_horizon_mode
+    3 the proforma spans the LONGEST DER lifetime (+ the CAPEX Year row),
+    with mode 2 the SHORTEST; under mode 2 replaceable and
+    non-replaceable DERs produce the same proforma; sizing combined with
+    either mode errors."""
+
+    def _proforma(self, name):
+        return DERVET(CBA_MP / name,
+                      base_path=REF).solve(backend="cpu").instances[0] \
+            .proforma_df
+
+    def test_longest_lifetime_proforma_length(self):
+        pf = self._proforma("longest_lifetime.csv")
+        assert len(pf.index) == 14 + 1  # longest lifetime + CAPEX Year row
+
+    def test_longest_lifetime_replaceable_proforma_length(self):
+        pf = self._proforma("longest_lifetime_replaceble.csv")
+        assert len(pf.index) == 14 + 1
+
+    def test_shortest_replacements_same_proforma(self):
+        no_rep = self._proforma("shortest_lifetime.csv")
+        rep = self._proforma("shortest_lifetime_replaceble.csv")
+        assert no_rep.shape == rep.shape
+        import numpy as np
+        assert np.allclose(no_rep.to_numpy(dtype=float),
+                           rep.to_numpy(dtype=float), rtol=1e-9)
+
+    @pytest.mark.parametrize("name", ["shortest_lifetime_sizing_error.csv",
+                                      "longest_lifetime_sizing_error.csv"])
+    def test_horizon_mode_with_sizing_errors(self, name):
+        from dervet_tpu.utils.errors import ParameterError
+        with pytest.raises(ParameterError):
+            DERVET(CBA_MP / name, base_path=REF).solve(backend="cpu")
