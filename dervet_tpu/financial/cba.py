@@ -156,12 +156,14 @@ class CostBenefitAnalysis:
         index = [CAPEX_ROW] + years
         proforma = pd.DataFrame(index=index)
 
+        growth_map: Dict[str, Optional[float]] = {}
         for der in ders:
             cols = self._der_columns(der, opt_years, results)
             for name, series in cols.items():
                 proforma[name] = series
+            # DER columns with their own escalation (PV PPA inflation)
+            growth_map.update(der.proforma_growth_rates())
 
-        growth_map: Dict[str, Optional[float]] = {}
         for vs in value_streams.values():
             df = vs.proforma_report(opt_years, poi, results)
             if df is None:
@@ -277,7 +279,10 @@ class CostBenefitAnalysis:
         # lifecycle: replacements at failure years (escalated at ter from
         # the operation year), decommissioning at min(end, last op + 1),
         # salvage at end of analysis (reference CBA.py:348-438 +
-        # DERExtension.py:162-265)
+        # DERExtension.py:162-265).  Non-owned assets (PV PPA) have none
+        # of these (IntermittentResourceSizing.py:295-316)
+        if not der.owns_asset():
+            return cols
         failure_years = der.set_failure_years(self.end_year, self.start_year)
         if der.replaceable and failure_years:
             rep = zero()
@@ -485,7 +490,7 @@ class CostBenefitAnalysis:
         depreciation starts at max(construction_year + 1, start_year);
         the disregard adds capex back so taxable income excludes it."""
         term = der.keys.get("macrs_term")
-        if not term:
+        if not term or not der.owns_asset():
             return None
         table = MACRS_TABLES.get(int(float(term)))
         if table is None:

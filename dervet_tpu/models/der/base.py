@@ -117,6 +117,17 @@ class DER:
         DER.proforma_report surface; CAPEX year handled by the CBA)."""
         return None
 
+    def owns_asset(self) -> bool:
+        """False when the host pays for output but does not own the asset
+        (PV PPA): the CBA then skips MACRS / replacement / decommissioning
+        / salvage for this DER."""
+        return True
+
+    def proforma_growth_rates(self) -> Dict[str, float]:
+        """Escalation rates for this DER's proforma columns in
+        fill-forward years (default: flat)."""
+        return {}
+
     def get_capex(self) -> float:
         return 0.0
 

@@ -121,6 +121,8 @@ class EnergyStorage(DER):
         ch = b.var(self.vname("ch"), T, lb=0.0, ub=self.charge_capacity())
         dis = b.var(self.vname("dis"), T, lb=0.0, ub=self.discharge_capacity())
         self._ts_limit_bounds(b, ctx, ene, ch, dis, e_min, e_max)
+        if self.incl_binary:
+            self._binary_onoff_rows(b, ctx, ch, dis)
 
         # BEGIN-of-step SOE convention (verified against the Usecase2 step2
         # golden to 1e-10): ene[t+1] = ene[t]*(1-sdr) + rte*dt*ch[t] -
@@ -344,6 +346,32 @@ class EnergyStorage(DER):
                 full = np.broadcast_to(np.asarray(arr, float), (ctx.T,))
                 self._ts_user_limits.setdefault(stem, {})[ctx.label] = \
                     pd.Series(full, index=ctx.index)
+
+    def _binary_onoff_rows(self, b: LPBuilder, ctx: WindowContext,
+                           ch, dis) -> None:
+        """Binary on/off formulation (scenario ``binary=1``): per-step
+        charge/discharge indicator variables enforce mutual exclusion and
+        the ch/dis minimum ratings (reference: storagevet EnergyStorage
+        ``on_c``/``on_d`` boolean variables behind CVXPY+GLPK_MI; the LP
+        IR marks the blocks integral and the scenario routes the window
+        to the exact CPU MILP backend)."""
+        T = ctx.T
+        on_c = b.var(self.vname("on_c"), T, binary=True)
+        on_d = b.var(self.vname("on_d"), T, binary=True)
+        # ch <= ch_max*on_c  ->  ch_max*on_c - ch >= 0
+        b.add_rows(self.vname("bin_ch_cap"),
+                   [(on_c, self.charge_capacity()), (ch, -1.0)], "ge", 0.0)
+        b.add_rows(self.vname("bin_dis_cap"),
+                   [(on_d, self.discharge_capacity()), (dis, -1.0)], "ge", 0.0)
+        if self.ch_min_rated:
+            b.add_rows(self.vname("bin_ch_min"),
+                       [(ch, 1.0), (on_c, -self.ch_min_rated)], "ge", 0.0)
+        if self.dis_min_rated:
+            b.add_rows(self.vname("bin_dis_min"),
+                       [(dis, 1.0), (on_d, -self.dis_min_rated)], "ge", 0.0)
+        # no simultaneous charge and discharge: on_c + on_d <= 1
+        b.add_rows(self.vname("bin_excl"),
+                   [(on_c, -1.0), (on_d, -1.0)], "ge", -1.0)
 
     def _daily_sum_matrix(self, ctx: WindowContext) -> sp.csr_matrix:
         """(n_days, T) matrix summing dis*dt per calendar day."""

@@ -5,8 +5,10 @@ RotatingGeneratorSizing.py + ICE.py + DieselGenset.py +
 CombustionTurbine.py + CombinedHeatPower.py (SURVEY.md §2.4) on the
 storagevet RotatingGenerator surface: electric output ``elec`` per
 timestep bounded by ``n * rated_capacity``; fuel + variable O&M costs in
-the objective.  The binary on/off + min-power formulation is relaxed in
-the LP (min_power requires MILP; the reference itself forbids
+the objective.  With scenario ``binary=1`` the on/off + min-power
+formulation is exact: a per-step binary indicator (solved on the CPU
+MILP backend) enforces ``elec ∈ {0} ∪ [min_power, rated]``; without it
+min_power relaxes to 0 with a warning (the reference itself forbids
 binary+sizing, MicrogridPOI.py:132-147).
 
 CHP adds recovered-heat variables (steam / hot water) tied to electric
@@ -42,7 +44,8 @@ class RotatingGenerator(DER):
         self.fixed_om_per_kw = g("fixed_om_cost")     # $/kW-yr
         self.ccost = g("ccost")
         self.ccost_kw = g("ccost_kW")
-        if self.min_power and not scenario.get("binary"):
+        self.incl_binary = bool(scenario.get("binary", False))
+        if self.min_power and not self.incl_binary:
             TellUser.warning(f"{self.name}: min_power needs the binary "
                              "formulation; relaxed to 0 in the LP")
 
@@ -89,6 +92,20 @@ class RotatingGenerator(DER):
                            label=f"{self.name} fuel_and_om")
             return
         elec = b.var(self.vname("elec"), ctx.T, lb=0.0, ub=self.max_power_out)
+        if self.incl_binary and self.min_power:
+            # unit-commitment formulation (reference RotatingGenerator
+            # on/off variables behind CVXPY+GLPK_MI): an INTEGER count of
+            # committed units per step bounds the fleet output to
+            # [min_power, rated_capacity] PER COMMITTED UNIT, so the
+            # feasible aggregate is {0} ∪ [min, rated] ∪ [2min, 2rated]…;
+            # the LP IR marks the block integral and the scenario routes
+            # such windows to the exact CPU MILP backend
+            n_on = b.var(self.vname("on"), ctx.T, lb=0.0,
+                         ub=float(self.n_units), integer=True)
+            b.add_rows(self.vname("bin_cap"),
+                       [(n_on, self.rated_power), (elec, -1.0)], "ge", 0.0)
+            b.add_rows(self.vname("bin_min"),
+                       [(elec, 1.0), (n_on, -self.min_power)], "ge", 0.0)
         if cost:
             b.add_cost(elec, cost * ctx.annuity_scalar,
                        label=f"{self.name} fuel_and_om")

@@ -40,6 +40,7 @@ class PV(DER):
         self.fixed_om_per_kw = g("fixed_om_cost")
         self.ppa = bool(keys.get("PPA", False))
         self.ppa_cost = g("PPA_cost")      # $/kWh production payment
+        self.ppa_inflation = g("PPA_inflation_rate") / 100.0
         self.growth = g("growth") / 100.0
         if datasets is None or datasets.time_series is None:
             raise TimeseriesDataError("PV requires a time series with "
@@ -94,10 +95,9 @@ class PV(DER):
             b.var(self.vname("gen"), ctx.T, lb=0.0, ub=gen_max)
         else:
             b.var(self.vname("gen"), ctx.T, lb=gen_max, ub=gen_max)
-        if self.ppa and self.ppa_cost:
-            b.add_cost(b[self.vname("gen")],
-                       self.ppa_cost * ctx.dt * ctx.annuity_scalar,
-                       label=f"{self.name} ppa_cost")
+        # PPA payments are on MAXIMUM (available) production, so they are
+        # sunk w.r.t. dispatch and appear only in the proforma
+        # (reference IntermittentResourceSizing.proforma_report:262-293)
         if self.fixed_om_per_kw:
             b.add_const_cost(self.fixed_om_per_kw * self.rated_capacity
                              * ctx.annuity_scalar * (ctx.T * ctx.dt) / 8760.0,
@@ -118,6 +118,42 @@ class PV(DER):
 
     def get_capex(self) -> float:
         return self.cost_per_kw * self.rated_capacity
+
+    def owns_asset(self) -> bool:
+        """Under a PPA the host does not own the panels: no MACRS, no
+        replacement, no decommissioning, no salvage (reference
+        IntermittentResourceSizing.py:295-316 returns empties)."""
+        return not self.ppa
+
+    def proforma_growth_rates(self) -> Dict[str, float]:
+        if self.ppa:
+            return {f"{self.unique_tech_id} PPA": self.ppa_inflation}
+        return {}
+
+    def proforma_report(self, opt_years, apply_inflation_rate_func=None,
+                        fill_forward_func=None):
+        """PPA: pay for each year's MAXIMUM (available) production at the
+        PPA price, escalated at the PPA inflation rate from the first
+        analysis year; otherwise the usual fixed O&M (reference
+        IntermittentResourceSizing.proforma_report:262-293)."""
+        uid = self.unique_tech_id
+        if not self.ppa:
+            if not self.fixed_om_per_kw:
+                return None
+            fixed = -self.fixed_om_per_kw * self.rated_capacity
+            return pd.DataFrame(
+                {f"{uid} Fixed O&M Cost": {pd.Period(yr, freq="Y"): fixed
+                                           for yr in opt_years}})
+        base = min(opt_years)
+        rows = {}
+        for yr in opt_years:
+            idx = self.datasets.time_series.index
+            year_idx = idx[idx.year == yr]
+            annual = float(self.maximum_generation_series(year_idx).sum()) \
+                * self.dt
+            rows[pd.Period(yr, freq="Y")] = \
+                -annual * self.ppa_cost * (1 + self.ppa_inflation) ** (yr - base)
+        return pd.DataFrame({f"{uid} PPA": rows})
 
     def replacement_cost(self) -> float:
         g = lambda k: float(self.keys.get(k, 0) or 0)

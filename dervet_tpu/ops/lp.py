@@ -62,6 +62,11 @@ class LP:
     # reporting (reference: objective_values CSV columns, e.g. 'retailETS')
     cost_groups: Dict[str, Tuple[np.ndarray, float]] = dataclasses.field(
         default_factory=dict)
+    # (n,) 0/1 integrality marks (scipy.optimize.milp convention) when any
+    # variable block was declared binary; None for a pure LP.  The binary
+    # on/off formulation solves on the exact CPU MILP backend — the PDHG
+    # TPU kernel is continuous-only (SURVEY §7 hard part #5)
+    integrality: Optional[np.ndarray] = None
 
     def objective_breakdown(self, x: np.ndarray) -> Dict[str, float]:
         """Per-label objective contributions for a solution vector."""
@@ -108,14 +113,20 @@ class LPBuilder:
         self._eq_rows: List[Tuple[str, int, list, np.ndarray]] = []
         self._ge_rows: List[Tuple[str, int, list, np.ndarray]] = []
         self._n = 0
+        self._binary: set = set()
 
     # ---------------- variables ----------------
-    def var(self, name: str, size: int, lb=-_INF, ub=_INF) -> VarRef:
+    def var(self, name: str, size: int, lb=-_INF, ub=_INF,
+            binary: bool = False, integer: bool = False) -> VarRef:
         if name in self._by_name:
             raise ValueError(f"duplicate variable block {name!r}")
         ref = VarRef(name, self._n, size)
         self._vars.append(ref)
         self._by_name[name] = ref
+        if binary:
+            lb, ub = 0.0, 1.0
+        if binary or integer:
+            self._binary.add(name)
         self._lb[name] = np.broadcast_to(np.asarray(lb, np.float64), (size,)).copy()
         self._ub[name] = np.broadcast_to(np.asarray(ub, np.float64), (size,)).copy()
         self._n += size
@@ -244,6 +255,11 @@ class LPBuilder:
             shape=(m, n),
         ).tocsr()
         q = np.concatenate(q_parts) if q_parts else np.zeros(0)
+        integrality = None
+        if self._binary:
+            integrality = np.zeros(n, np.int8)
+            for name in self._binary:
+                integrality[self._by_name[name].sl] = 1
         return LP(c=c, K=K, q=q, n_eq=n_eq, l=l, u=u,
                   var_refs=dict(self._by_name), row_groups=groups, c0=self._c0,
-                  cost_groups=cost_groups)
+                  cost_groups=cost_groups, integrality=integrality)

@@ -88,24 +88,39 @@ class MicrogridScenario:
         ts = case.datasets.time_series
         if ts is None:
             raise TimeseriesDataError("a time_series_filename is required")
-        # every user opt_year must exist in the referenced data — the
-        # reference REJECTS rather than growth-fills missing years
-        # (test_1params.py:97-124: 025 -> TimeseriesDataError, 039 ->
-        # MonthlyDataError).  io/growth.py keeps the storagevet Library
-        # fill/drop surface available to API users (deferral projections
-        # here grow load in-stream instead, models/streams/programs.py)
-        data_years = set(int(y) for y in ts.index.year.unique())
-        missing = sorted(y for y in self.opt_years if y not in data_years)
-        if missing:
-            raise TimeseriesDataError(
-                f"time series data has no rows for opt_years {missing}")
+        # A missing opt_year is growth-synthesized ONLY when it extends the
+        # data contiguously (its prior year exists in the data or was
+        # itself synthesized); a gap is rejected.  This is the reference's
+        # observable rule (test_1params.py:97-124 + test_3battery.py:94):
+        # 007 (data 2017, opt 2017+2018) runs, 025 (data 2017, opt
+        # 2017+2019) raises TimeseriesDataError, 039 (monthly 2017, opt
+        # 2017+2019) raises MonthlyDataError.
+        def check_contiguous(years_in_data, exc, what):
+            avail = set(years_in_data)
+            for y in sorted(self.opt_years):
+                if y not in avail:
+                    if y - 1 in avail:
+                        avail.add(y)      # synthesizable by growth
+                    else:
+                        raise exc(
+                            f"{what} has no rows for opt_year {y} and no "
+                            f"{y - 1} data to grow it from")
+
+        check_contiguous((int(y) for y in ts.index.year.unique()),
+                         TimeseriesDataError, "time series data")
         if case.datasets.monthly is not None:
-            myears = set(int(y) for y in
-                         case.datasets.monthly.index.get_level_values(0))
-            mmissing = sorted(y for y in self.opt_years if y not in myears)
-            if mmissing:
-                raise MonthlyDataError(
-                    f"monthly data has no rows for opt_years {mmissing}")
+            check_contiguous(
+                (int(y) for y in
+                 case.datasets.monthly.index.get_level_values(0)),
+                MonthlyDataError, "monthly data")
+        from ..io.growth import (column_growth_rates, fill_extra_data,
+                                 fill_extra_monthly)
+        rates = column_growth_rates(self.scenario, case.streams, ts.columns)
+        ts = fill_extra_data(ts, self.opt_years, rates)
+        case.datasets.time_series = ts
+        if case.datasets.monthly is not None:
+            case.datasets.monthly = fill_extra_monthly(
+                case.datasets.monthly, self.opt_years)
         keep = ts.index.year.isin(self.opt_years)
         ts = ts.loc[keep]
         if not len(ts):
@@ -439,6 +454,32 @@ class MicrogridScenario:
         ctxs = [p[0] for p in pairs]
         lps = [p[1] for p in pairs]
         xs, objs, ok, diags = self._solve_group(lps[0], lps, backend, solver_opts)
+        # binary on/off cases: the batched backend solves the RELAXATION;
+        # only windows whose relaxed solution is not binary-repairable
+        # (simultaneous ch/dis, sub-min-power running) re-solve on the
+        # exact CPU MILP — typical windows never leave the TPU
+        if backend != "cpu":
+            # check tolerance follows the relaxation's own accuracy so
+            # loosened PDHG settings don't read first-order noise as
+            # cheating and forfeit the batched path
+            bin_tol = max(getattr(solver_opts, "eps_rel", 0.0) or 0.0, 1e-4)
+            for i, lp in enumerate(lps):
+                if lp.integrality is None:
+                    continue
+                if ok[i] and cpu_ref.binary_feasible(lp, xs[i], tol=bin_tol):
+                    continue
+                # relaxation cheated (fractional on/off) — or failed to
+                # converge at all, which is the wrong abort criterion for
+                # an integral LP: either way the exact MILP rescues it
+                TellUser.info(
+                    f"window {ctxs[i].label}: "
+                    + ("relaxation exploits fractional on/off"
+                       if ok[i] else "relaxation did not converge")
+                    + "; re-solving as exact MILP")
+                res = cpu_ref.solve_lp_cpu(lp)
+                xs[i], objs[i] = res.x, res.obj
+                ok[i] = res.status == 0
+                diags[i] = res.message or diags[i]
         for ctx, lp, x, obj, converged, diag in zip(ctxs, lps, xs, objs, ok,
                                                     diags):
             if not converged:

@@ -1,0 +1,111 @@
+"""Binary on/off formulation (scenario ``binary=1``): exact MILP on the
+CPU backend (reference: CVXPY+GLPK_MI boolean variables; SURVEY §2.9 —
+the continuous PDHG kernel gets the batched axis, one-off hard problems
+route to the exact CPU solver)."""
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dervet_tpu.io.params import Params
+from dervet_tpu.scenario.scenario import MicrogridScenario
+
+REF = Path("/root/reference")
+MP = REF / "test/test_storagevet_features/model_params"
+
+
+def _base_case(**scenario_overrides):
+    case = Params.initialize(MP / "000-DA_battery_month.csv",
+                             base_path=REF)[0]
+    case.scenario["allow_partial_year"] = True   # tests trim to January
+    case.scenario.update(scenario_overrides)
+    for tag, _id, keys in case.ders:
+        if tag == "Battery":
+            # free the discharge budget so the energy-burning relaxation
+            # artifact is actually profitable (the cycle cap otherwise
+            # spends all discharge kWh on ordinary arbitrage)
+            keys["daily_cycle_limit"] = 0
+    return case
+
+
+def test_binary_battery_no_simultaneous_charge_discharge():
+    """Negative prices + a full battery make simultaneous charge/discharge
+    profitable in the LP relaxation (burning energy through the round-trip
+    loss while being paid to consume); the binary formulation forbids it."""
+    case = _base_case(binary=1)
+    ts = case.datasets.time_series
+    price_col = next(c for c in ts.columns if "DA Price" in c)
+    prices = ts[price_col].to_numpy().copy()
+    prices[:12] = -0.05                 # half a negative day
+    ts[price_col] = prices
+    # 1-day horizon keeps branch-and-bound small (48 binaries)
+    case.datasets.time_series = ts.iloc[: 24]
+    s = MicrogridScenario(case)
+    s.optimize_problem_loop(backend="jax")   # must route itself to MILP
+    res = s.timeseries_results()
+    bat = next(d for d in s.ders if d.tag == "Battery")
+    ch = res[bat.col("Charge (kW)")].to_numpy()
+    dis = res[bat.col("Discharge (kW)")].to_numpy()
+    assert (np.minimum(ch, dis) <= 1e-6).all()
+    # the negative-price window actually pays the battery to charge
+    assert ch[:12].max() > 0
+
+
+def test_relaxed_battery_does_simultaneously_dump():
+    """Sanity for the test above: WITHOUT binary, the same case exploits
+    the relaxation (otherwise the binary assertion proves nothing)."""
+    case = _base_case(binary=0)      # the input file sets binary=1
+    ts = case.datasets.time_series
+    price_col = next(c for c in ts.columns if "DA Price" in c)
+    prices = ts[price_col].to_numpy().copy()
+    prices[:12] = -0.05
+    ts[price_col] = prices
+    case.datasets.time_series = ts.iloc[: 24]
+    s = MicrogridScenario(case)
+    s.optimize_problem_loop(backend="cpu")
+    res = s.timeseries_results()
+    bat = next(d for d in s.ders if d.tag == "Battery")
+    ch = res[bat.col("Charge (kW)")].to_numpy()
+    dis = res[bat.col("Discharge (kW)")].to_numpy()
+    assert np.minimum(ch, dis).max() > 1.0
+
+
+def test_binary_genset_min_power():
+    """ICE with min_power under binary=1: output is 0 or >= min_power."""
+    case = _base_case(binary=1)
+    case.ders.append(("ICE", "1", {
+        "name": "genset", "rated_capacity": 500, "n": 1, "min_power": 200,
+        "efficiency": 12.0, "fuel_cost": 1.0, "variable_om_cost": 0.001,
+        "fixed_om_cost": 0.0}))
+    ts = case.datasets.time_series
+    case.datasets.time_series = ts.iloc[: 24 * 2]
+    s = MicrogridScenario(case)
+    s.optimize_problem_loop(backend="jax")
+    res = s.timeseries_results()
+    ice = next(d for d in s.ders if d.tag == "ICE")
+    gen = res[ice.col("Electric Generation (kW)")].to_numpy()
+    on = gen > 1e-6
+    assert (gen[on] >= 200 - 1e-4).all()
+    assert (gen <= 500 + 1e-6).all()
+
+
+def test_binary_genset_multi_unit_commitment():
+    """n=2 units with min_power: aggregate output lands in
+    {0} u [min, rated] u [2*min, 2*rated] (integer commitment count)."""
+    case = _base_case(binary=1)
+    case.ders.append(("ICE", "1", {
+        "name": "fleet", "rated_capacity": 500, "n": 2, "min_power": 400,
+        "efficiency": 12.0, "fuel_cost": 1.0, "variable_om_cost": 0.001,
+        "fixed_om_cost": 0.0}))
+    ts = case.datasets.time_series
+    case.datasets.time_series = ts.iloc[: 24]
+    s = MicrogridScenario(case)
+    s.optimize_problem_loop(backend="cpu")
+    res = s.timeseries_results()
+    ice = next(d for d in s.ders if d.tag == "ICE")
+    gen = res[ice.col("Electric Generation (kW)")].to_numpy()
+    tol = 1e-4
+    in_zero = gen <= tol
+    in_one = (gen >= 400 - tol) & (gen <= 500 + tol)
+    in_two = (gen >= 800 - tol) & (gen <= 1000 + tol)
+    assert (in_zero | in_one | in_two).all()

@@ -116,3 +116,33 @@ class TestLifetimeHorizons:
         from dervet_tpu.utils.errors import ParameterError
         with pytest.raises(ParameterError):
             DERVET(CBA_MP / name, base_path=REF).solve(backend="cpu")
+
+
+def test_ppa_payment():
+    """PV PPA (reference xtest_ppa + IntermittentResourceSizing.py:262-316):
+    the proforma carries a PPA column priced on MAXIMUM production,
+    escalated at the PPA inflation rate, and the non-owned panels have no
+    MACRS/replacement/decommissioning/salvage entries."""
+    inst = DERVET(CBA_MP / "ppa_payment.csv",
+                  base_path=REF).solve(backend="cpu").instances[0]
+    pf = inst.proforma_df
+    ppa_cols = [c for c in pf.columns if c.endswith(" PPA")]
+    assert len(ppa_cols) == 1
+    ppa = pf[ppa_cols[0]]
+    pv = next(d for d in inst.scenario.ders if d.tag == "PV")
+    assert not pv.owns_asset()
+    # pays for production every operating year (zeroed after EOL like any
+    # dead DER, reference zero_out_dead_der_costs)
+    years = [y for y in pf.index
+             if y != "CAPEX Year" and y <= pv.last_operation_year]
+    assert years and (ppa[years] < 0).all()
+    # escalation at the PPA inflation rate year over year (equal annual
+    # production profile -> constant ratio)
+    ratios = (ppa[years].to_numpy()[1:] / ppa[years].to_numpy()[:-1])
+    assert np.allclose(ratios, 1 + pv.ppa_inflation, rtol=1e-6)
+    uid = pv.unique_tech_id
+    for stem in ("MACRS Depreciation", "Replacement Costs",
+                 "Decommissioning Cost", "Salvage Value"):
+        col = f"{uid} {stem}"
+        if col in pf.columns:
+            assert (pf[col] == 0).all(), col
