@@ -14,6 +14,7 @@ import numpy as np
 import scipy.sparse as sp
 from scipy.optimize import linprog
 
+from ..utils.errors import TellUser
 from .lp import LP
 
 
@@ -37,6 +38,10 @@ def solve_lp_cpu(lp: LP, c=None, q=None, l=None, u=None) -> CPUResult:
         relaxed = dataclasses.replace(lp, integrality=None)
         res = solve_lp_cpu(relaxed, c, q, l, u)
         if res.status == 0 and binary_feasible(lp, res.x, q=q):
+            return res
+        if res.status == 2:
+            # relaxation proven infeasible => the MILP is too; don't
+            # spend branch-and-bound re-proving it
             return res
         return _solve_milp(lp, c, q, l, u)
     K_eq = lp.K[: lp.n_eq]
@@ -68,6 +73,11 @@ def _solve_milp(lp: LP, c, q, l, u) -> CPUResult:
                options={"mip_rel_gap": 1e-4, "time_limit": 300.0})
     x = res.x if res.x is not None else np.full(lp.n, np.nan)
     ok = res.x is not None and res.status in (0, 1)  # 1 = limit w/ incumbent
+    if ok and res.status == 1:
+        # incumbent accepted at the time limit: optimality gap unknown —
+        # surface it like the PDHG STATUS_INACCURATE path does
+        TellUser.warning(
+            f"MILP hit its time limit; using the incumbent ({res.message})")
     return CPUResult(x=x, obj=float(res.fun) if res.fun is not None else np.nan,
                      status=0 if ok else int(res.status or 1),
                      message=str(res.message))

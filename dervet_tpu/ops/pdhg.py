@@ -231,6 +231,15 @@ class PDHGOptions:
     # runtime watchdogs (a 100k-iteration year-long LP is minutes of
     # uninterrupted device time otherwise) and gives progress visibility.
     chunk_iters: int = 16384
+    # chunk size for the BATCHED driver only: it doubles as the
+    # granularity of active-set compaction — most instances converge in
+    # the first chunk, so a moderate chunk re-batches the stragglers
+    # early instead of billing their iterations to the whole batch
+    # (measured on the 20x20 sizing sweep: 84s at 16384 without
+    # compaction, 48s with, 28s at 4096).  Single-instance and sharded
+    # drivers keep the larger chunk_iters — they have no compaction and
+    # would only pay extra ~100ms remote status fetches.
+    compact_chunk_iters: int = 4096
     dtype: jnp.dtype = jnp.float32
     # TPU MXU default precision is bf16, which is NOT enough for PDHG to
     # converge (the iteration amplifies matvec rounding through the box
@@ -637,18 +646,57 @@ class CompiledLPSolver:
         args = (self.op, c, q, l, u, self.dr, self.dc)
         state = init(*args)
         max_iters = self.opts.max_iters
+        if not batched:
+            total = 0
+            while True:
+                limit = np.int32(min(total + self.opts.chunk_iters,
+                                     max_iters))
+                state = chunk(*args, self.eta, state, limit)
+                # ONE tiny fused readback per chunk: a remote-device fetch
+                # costs ~100 ms of latency regardless of size
+                total, n_active = (int(v) for v in np.asarray(
+                    _status_scalars(state.total, state.converged,
+                                    state.infeasible)))
+                if n_active == 0 or total >= max_iters:
+                    break
+            return fin(*args, state)
+
+        # Batched: ACTIVE-SET COMPACTION between chunks.  The vmapped
+        # while_loop runs until the WORST instance converges, so a few
+        # ill-conditioned stragglers (e.g. extreme sizing-sweep
+        # candidates at 20x the median iteration count) would otherwise
+        # bill their iterations to the entire batch.  Once most of the
+        # batch is done, gather the survivors into a power-of-2 bucket
+        # (bounding recompiles) and keep iterating only those; scatter
+        # results back before finalizing on the full batch.
+        B = c.shape[0]
+        idx = np.arange(B)            # sub-batch row -> original position
+        cur = (c, q, l, u)
+        cur_state = state
+        full_state = state
         total = 0
         while True:
-            limit = np.int32(min(total + self.opts.chunk_iters, max_iters))
-            state = chunk(*args, self.eta, state, limit)
-            # ONE tiny fused readback per chunk: a remote-device fetch costs
-            # ~100 ms of latency over the tunnel regardless of size
+            limit = np.int32(min(total + self.opts.compact_chunk_iters,
+                                 max_iters))
+            cur_state = chunk(self.op, *cur, self.dr, self.dc, self.eta,
+                              cur_state, limit)
             total, n_active = (int(v) for v in np.asarray(
-                _status_scalars(state.total, state.converged,
-                                state.infeasible)))
+                _status_scalars(cur_state.total, cur_state.converged,
+                                cur_state.infeasible)))
             if n_active == 0 or total >= max_iters:
                 break
-        return fin(*args, state)
+            bucket = max(8, 1 << (max(n_active - 1, 0).bit_length()))
+            if bucket <= len(idx) // 2:
+                act = ~(np.asarray(cur_state.converged)
+                        | np.asarray(cur_state.infeasible))
+                sel = np.nonzero(act)[0]
+                pad = np.resize(sel, bucket)   # pad by repeating survivors
+                full_state = _scatter_state(full_state, cur_state, idx)
+                idx = idx[pad]
+                cur = tuple(a[pad] for a in cur)
+                cur_state = jax.tree.map(lambda a: a[pad], cur_state)
+        full_state = _scatter_state(full_state, cur_state, idx)
+        return fin(*args, full_state)
 
     def batch_data(self, B: int, c, q, l, u):
         """Broadcast any shared 1-D arrays up to the batch dimension."""
@@ -657,6 +705,13 @@ class CompiledLPSolver:
         l = jnp.broadcast_to(l, (B, self.lp.n)) if l.ndim == 1 else l
         u = jnp.broadcast_to(u, (B, self.lp.n)) if u.ndim == 1 else u
         return c, q, l, u
+
+
+def _scatter_state(full: "_State", sub: "_State", idx: np.ndarray) -> "_State":
+    """Write sub-batch state rows back into the full-batch state.
+    ``idx`` may repeat positions (bucket padding); duplicates carry
+    identical rows, so later writes are no-ops."""
+    return jax.tree.map(lambda f, s: f.at[idx].set(s), full, sub)
 
 
 @jax.jit

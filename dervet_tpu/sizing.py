@@ -1,0 +1,134 @@
+"""Grid sizing sweep on the batch axis (the north-star pattern).
+
+The reference sizes by making ratings CVXPY variables inside one MILP
+(``ESSSizing.py:82-138``); this framework's continuous-sizing path mirrors
+that (``models/der/ess.py::_build_sizing``).  The TPU-NATIVE alternative
+this module adds is the BASELINE.json north-star shape: enumerate a
+(power x energy) candidate grid and let the grid BE the batch axis — every
+candidate's year of dispatch windows solves in one batched PDHG call per
+window-length group, so a 20x20 sweep costs barely more wall time than a
+single case and returns the full response surface instead of one point
+(VERDICT r1 next-round item 8).
+
+All candidates share one LP *structure* per window (fixed-size builds
+differ only in bounds/rhs/costs), which is exactly what
+:class:`CompiledLPSolver`'s batched data path wants.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+from .io.params import CaseParams
+from .ops.pdhg import CompiledLPSolver, PDHGOptions
+from .scenario.scenario import MicrogridScenario
+from .utils.errors import ParameterError, TellUser
+
+
+def _candidate_scenario(case: CaseParams, der_tag: str, der_id: str,
+                        kw: float, kwh: float) -> MicrogridScenario:
+    """A scenario whose target ESS is fixed at the candidate ratings."""
+    c = copy.deepcopy(case)
+    found = False
+    for tag, i, keys in c.ders:
+        if tag == der_tag and (i or "1") == (der_id or "1"):
+            keys["ch_max_rated"] = kw
+            keys["dis_max_rated"] = kw
+            keys["ene_max_rated"] = kwh
+            found = True
+    if not found:
+        raise ParameterError(f"sizing_sweep: no {der_tag} id={der_id!r}")
+    return MicrogridScenario(c)
+
+
+def sizing_sweep(case: CaseParams, kw_grid: Sequence[float],
+                 kwh_grid: Sequence[float], der_tag: str = "Battery",
+                 der_id: str = "1", solver_opts: Optional[PDHGOptions] = None,
+                 ) -> pd.DataFrame:
+    """Sweep an ESS power/energy grid; dispatch every candidate's year on
+    the batch axis.
+
+    Returns a DataFrame with one row per (kW, kWh) candidate:
+
+    * ``operating_value`` — total dispatch objective over the year
+      (negative = net benefit), summed across windows
+    * ``capex`` — the candidate's capital cost
+    * ``total`` — operating_value + capex (rank by this; it is the
+      sweep's analogue of the sizing LP's objective)
+    * ``converged`` — all of the candidate's windows converged
+
+    The grid is dense by construction — callers read the response
+    surface, pick a region, and refine with a tighter grid or the exact
+    continuous-sizing path.
+    """
+    candidates: List[Tuple[float, float]] = [
+        (float(kw), float(kwh)) for kw in kw_grid for kwh in kwh_grid]
+    if not candidates:
+        raise ParameterError("sizing_sweep: empty candidate grid")
+
+    # one scenario per candidate (host-side assembly); window STRUCTURE is
+    # identical across candidates, so LPs group by window length and the
+    # candidate axis concatenates into the solver's batch dimension
+    scens = [_candidate_scenario(case, der_tag, der_id, kw, kwh)
+             for kw, kwh in candidates]
+    groups: Dict[int, List[Tuple[int, object]]] = {}
+    for ci, s in enumerate(scens):
+        if s.poi.is_sizing_optimization:
+            raise ParameterError(
+                "sizing_sweep drives FIXED-size candidates; zero ratings "
+                "elsewhere in the case would add size variables")
+        for ctx in s.windows:
+            lp = s.build_window_lp(ctx)
+            groups.setdefault(ctx.T, []).append((ci, lp))
+
+    n_cand = len(candidates)
+    op_value = np.zeros(n_cand)
+    all_ok = np.ones(n_cand, bool)
+    for T, entries in sorted(groups.items()):
+        lps = [lp for _, lp in entries]
+        lp0 = lps[0]
+        solver = CompiledLPSolver(lp0, solver_opts or PDHGOptions())
+        C = np.stack([lp.c for lp in lps])
+        Q = np.stack([lp.q for lp in lps])
+        L = np.stack([lp.l for lp in lps])
+        U = np.stack([lp.u for lp in lps])
+        res = solver.solve(c=C, q=Q, l=L, u=U)
+        objs = np.asarray(res.obj)
+        ok = np.asarray(res.converged)
+        for k, (ci, lp) in enumerate(entries):
+            op_value[ci] += float(objs[k]) + lp.c0
+            all_ok[ci] &= bool(ok[k])
+        TellUser.debug(f"sizing_sweep: group T={T} solved "
+                       f"{len(entries)} window-LPs")
+
+    rows = []
+    for ci, (kw, kwh) in enumerate(candidates):
+        der = next(d for d in scens[ci].ders
+                   if d.tag == der_tag and (d.id or "1") == (der_id or "1"))
+        capex = der.get_capex()
+        rows.append({"kW": kw, "kWh": kwh,
+                     "operating_value": op_value[ci], "capex": capex,
+                     "total": op_value[ci] + capex,
+                     "converged": bool(all_ok[ci])})
+    out = pd.DataFrame(rows)
+    # vectorized per-candidate lifetime NPV (the north-star's "batched
+    # proforma without a Python loop"): the optimized year's net operating
+    # value recurs with inflation over the project horizon, discounted at
+    # the case's rate, less capex in year zero
+    fin = case.finance
+    rate = float(fin.get("npv_discount_rate", 0) or 0) / 100.0
+    infl = float(fin.get("inflation_rate", 0) or 0) / 100.0
+    s0 = scens[0]
+    n_years = s0.end_year - s0.start_year + 1
+    k = np.arange(1, n_years + 1)
+    annuity = float(np.sum((1 + infl) ** (k - 1) / (1 + rate) ** k))
+    out["lifetime_npv"] = -out["capex"] - out["operating_value"] * annuity
+    best = out.loc[out[out.converged]["total"].idxmin()] if \
+        out.converged.any() else None
+    if best is not None:
+        TellUser.info(f"sizing_sweep: best candidate {best['kW']:.0f} kW / "
+                      f"{best['kWh']:.0f} kWh (total {best['total']:.0f})")
+    return out
