@@ -51,7 +51,10 @@ def synthetic_timeseries(year: int = 2017, dt: float = 1.0,
 
 def synthetic_case(year: int = 2017, n="month", dt: float = 1.0,
                    battery_kw: float = 2000.0, battery_kwh: float = 8000.0,
-                   pv_kw: float = 3000.0, seed: int = 0) -> CaseParams:
+                   pv_kw: float = 3000.0, seed: int = 0,
+                   multi_der: bool = False) -> CaseParams:
+    """Battery+PV+DA north-star case; ``multi_der=True`` adds ICE + CHP
+    with thermal load (BASELINE configs 3/5 microgrid shape)."""
     ts = synthetic_timeseries(year, dt, seed)
     scenario = {"dt": dt, "n": n, "opt_years": [year], "start_year": year,
                 "end_year": year, "incl_site_load": True}
@@ -61,10 +64,29 @@ def synthetic_case(year: int = 2017, n="month", dt: float = 1.0,
                "OMexpenses": 0.5, "ccost_kwh": 100.0, "ccost_kw": 200.0}
     pv = {"name": "bench_pv", "rated_capacity": pv_kw, "curtail": True,
           "ccost_kW": 1000.0}
+    ders = [("Battery", "1", battery), ("PV", "1", pv)]
+    if multi_der:
+        ders.append(("ICE", "1", {
+            "name": "bench_ice", "rated_capacity": 1000.0, "n": 2,
+            "efficiency": 11.0, "fuel_cost": 2.5, "variable_om_cost": 0.004,
+            "fixed_om_cost": 10.0, "ccost_kW": 600.0}))
+        ders.append(("CHP", "1", {
+            "name": "bench_chp", "rated_capacity": 800.0, "n": 1,
+            # kW electric per BTU/hr of recovered heat (reference unit
+            # convention; see tests/test_thermal.py)
+            "electric_heat_ratio": 0.0015, "fuel_cost": 2.0,
+            "variable_om_cost": 0.003, "ccost_kW": 900.0}))
+        scenario["incl_thermal_load"] = True
+        rng = np.random.default_rng(seed + 1)
+        hours = ts.index.hour.to_numpy()
+        # within the CHP's recoverable heat: 800 kW / 0.0015 = 533 kBTU/hr
+        ts["Site Hot Water Thermal Load (BTU/hr)"] = 1e5 * (
+            2.0 + np.sin(2 * np.pi * (hours - 6) / 24)
+            + 0.2 * rng.standard_normal(len(ts)))
     return CaseParams(
         case_id=0, scenario=scenario,
         finance={"npv_discount_rate": 7.0, "inflation_rate": 3.0},
-        results={}, ders=[("Battery", "1", battery), ("PV", "1", pv)],
+        results={}, ders=ders,
         streams={"DA": {"growth": 0.0}},
         datasets=Datasets(time_series=ts),
     )

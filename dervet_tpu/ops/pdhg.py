@@ -37,10 +37,27 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
+
+# Persistent XLA compilation cache: the batched solver's first compile is
+# tens of seconds per (shape, backend) on TPU; caching it on disk makes
+# every later process warm-start.  Opt out with DERVET_TPU_NO_XLA_CACHE=1
+# or point DERVET_TPU_XLA_CACHE at a different directory.
+if not os.environ.get("DERVET_TPU_NO_XLA_CACHE"):
+    try:
+        _cache_dir = os.environ.get(
+            "DERVET_TPU_XLA_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "dervet_tpu_xla"))
+        os.makedirs(_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:                       # never let caching break solves
+        pass
 import numpy as np
 
 from .lp import LP
