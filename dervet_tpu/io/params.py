@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 import pandas as pd
 
+from .allowed import check_allowed
 from .schema import DER_TAGS, SCHEMA, SINGLE_INSTANCE_TAGS
 from ..utils.errors import ModelParameterError, TellUser
 
@@ -435,8 +436,12 @@ class Params:
                     j = sens_idx.get((r.tag, r.id, r.key), 0)
                     raw_ev = parts[j]
                 try:
-                    cba_overrides[(r.tag, r.id, r.key)] = convert_value(
-                        raw_ev, declared, key=f"{r.tag}.{r.key}")
+                    ev = convert_value(raw_ev, declared,
+                                       key=f"{r.tag}.{r.key}")
+                    err = check_allowed(r.tag, r.key, ev)
+                    if err:
+                        raise ModelParameterError(f"Evaluation value: {err}")
+                    cba_overrides[(r.tag, r.id, r.key)] = ev
                 except (ValueError, TypeError) as e:
                     raise ModelParameterError(
                         f"bad Evaluation value {raw_ev!r} for "
@@ -449,6 +454,9 @@ class Params:
             except (ValueError, TypeError) as e:
                 raise ModelParameterError(
                     f"bad value {raw!r} for {r.tag}.{r.key} (type {declared}): {e}")
+            err = check_allowed(r.tag, r.key, val)
+            if err:
+                raise ModelParameterError(err)
             tag_maps.setdefault((r.tag, r.id), {})[r.key] = val
 
         scenario = next((v for (t, _), v in tag_maps.items() if t == "Scenario"), {})

@@ -62,6 +62,16 @@ class EnergyStorage(DER):
         if (self.ch_min_rated or self.dis_min_rated) and not self.incl_binary:
             TellUser.warning(f"{self.name}: nonzero ch/dis minimums require the "
                              "binary formulation; ignored in the LP relaxation")
+        # startup costs ride the binary on/off indicators (reference:
+        # EnergyStorage incl_startup + p_start_ch/p_start_dis surface,
+        # wired through ESSSizing.py:389-396)
+        self.incl_startup = bool(keys.get("startup", False))
+        self.p_start_ch = g("p_start_ch")
+        self.p_start_dis = g("p_start_dis")
+        if self.incl_startup and not self.incl_binary:
+            TellUser.warning(
+                f"{self.name}: startup=1 requires the binary formulation "
+                "(scenario binary=1); startup costs are NOT applied")
         # fraction of rated energy usable (degradation hooks update this)
         self.soh = 1.0
         # sizing: a zero rating is a size decision variable (reference:
@@ -372,6 +382,34 @@ class EnergyStorage(DER):
         # no simultaneous charge and discharge: on_c + on_d <= 1
         b.add_rows(self.vname("bin_excl"),
                    [(on_c, -1.0), (on_d, -1.0)], "ge", -1.0)
+        if self.incl_startup:
+            self._startup_rows(b, ctx, on_c, on_d)
+
+    def _startup_rows(self, b: LPBuilder, ctx: WindowContext,
+                      on_c, on_d) -> None:
+        """Startup-cost formulation: ``start[t] >= on[t] - on[t-1]`` with
+        cost ``p_start * sum(start)`` — positive cost drives each start
+        indicator to exactly max(0, rising edge), so the continuous start
+        block stays exact without extra integrality (reference: the
+        EnergyStorage startup surface, incl_startup/p_start_ch/p_start_dis,
+        ESSSizing.py:389-396).  The first step of a window is not charged
+        (no prior on-state to compare against, matching the per-window
+        reference objective)."""
+        T = ctx.T
+        if T < 2:
+            return
+        # row t (t=1..T-1):  start[t] - on[t] + on[t-1] >= 0
+        pick = sp.eye(T, format="csr")[1:]               # selects x[1:]
+        diff = pick - sp.eye(T, format="csr")[:-1]       # x[t] - x[t-1]
+        for which, on, p_start in (("ch", on_c, self.p_start_ch),
+                                   ("dis", on_d, self.p_start_dis)):
+            if not p_start:
+                continue
+            start = b.var(self.vname(f"start_{which}"), T, lb=0.0, ub=1.0)
+            b.add_rows(self.vname(f"startup_{which}"),
+                       [(start, pick), (on, -diff)], "ge", 0.0)
+            b.add_cost(start, p_start * ctx.annuity_scalar,
+                       label=f"{self.name} startup")
 
     def _daily_sum_matrix(self, ctx: WindowContext) -> sp.csr_matrix:
         """(n_days, T) matrix summing dis*dt per calendar day."""

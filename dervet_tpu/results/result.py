@@ -54,9 +54,21 @@ class Result:
         return df
 
     def save_as_csv(self, out_dir=None) -> None:
+        out = Path(out_dir or self.dir_abs_path)
         for key, inst in self.instances.items():
             label = f"{self.csv_label}{key}" if len(self.instances) > 1 else self.csv_label
-            inst.save_as_csv(Path(out_dir or self.dir_abs_path), label)
+            inst.save_as_csv(out, label)
+        if len(self.instances) > 1:
+            # one summary row per sensitivity case (reference:
+            # storagevet.Result.sensitivity_summary written from
+            # dervet/DERVET.py:85)
+            df = getattr(self, "sensitivity_summary_df", None)
+            if df is None:
+                df = self.sensitivity_summary()
+            if df is not None:
+                out.mkdir(parents=True, exist_ok=True)
+                df.to_csv(out / "sensitivity_summary.csv",
+                          index_label="Case")
 
 
 class CaseResult:
@@ -161,19 +173,28 @@ class CaseResult:
     # ------------------------------------------------------------------
     def save_as_csv(self, path: Path, label: str = "") -> None:
         path.mkdir(parents=True, exist_ok=True)
-        def put(name, df, index=True):
+
+        def put(name, df, index=True, core=False):
+            # the reference's output file SET is fixed: a core file with no
+            # content is still written, as an empty CSV (e.g. the frozen
+            # reliability-only results carry empty objective_values/
+            # monthly_data/payback files)
+            if df is None and core:
+                df = pd.DataFrame()
             if df is not None:
                 df.to_csv(path / f"{name}{label}.csv", index=index)
-        put("timeseries_results", self.time_series_data)
-        put("technology_summary", self.technology_summary, index=False)
-        put("size", self.sizing_df)
-        put("monthly_data", self.monthly_data)
-        put("objective_values", self.objective_values)
-        put("pro_forma", self.proforma_df)
-        put("npv", self.npv_df, index=False)
-        put("payback", self.payback_df, index=False)
-        put("cost_benefit", self.cost_benefit_df)
-        put("equipment_lifetimes", getattr(self, "equipment_lifetimes_df", None))
+        put("timeseries_results", self.time_series_data, core=True)
+        put("technology_summary", self.technology_summary, index=False,
+            core=True)
+        put("size", self.sizing_df, core=True)
+        put("monthly_data", self.monthly_data, core=True)
+        put("objective_values", self.objective_values, core=True)
+        put("pro_forma", self.proforma_df, core=True)
+        put("npv", self.npv_df, index=False, core=True)
+        put("payback", self.payback_df, index=False, core=True)
+        put("cost_benefit", self.cost_benefit_df, core=True)
+        put("equipment_lifetimes",
+            getattr(self, "equipment_lifetimes_df", None), core=True)
         put("tax_breakdown", getattr(self, "tax_breakdown_df", None))
         put("ecc_breakdown", getattr(self, "ecc_breakdown_df", None))
         for name, df in self.drill_down_dict.items():

@@ -33,6 +33,7 @@ class POI:
         self.max_export = float(scenario_keys.get("max_export", 0) or 0)
         self.max_import = float(scenario_keys.get("max_import", 0) or 0)
         self.incl_site_load = bool(scenario_keys.get("incl_site_load", False))
+        self.use_slack = bool(scenario_keys.get("slack", False))
         if self.apply_poi_constraints and self.max_import > 0:
             raise ParameterError(
                 f"max_import must be <= 0 (import is negative net export), "
@@ -228,6 +229,21 @@ class POI:
                 TellUser.warning(f"system requirement {kind}/{sense} has no "
                                  "contributing DERs — skipped")
                 continue
+            # Scenario.slack=1 turns the energy/charge/discharge system
+            # requirements into SOFT constraints: a nonnegative violation
+            # variable enters the row and the objective at the kappa_*
+            # penalty (reference: the storagevet Scenario slack surface —
+            # kappa_ene/ch/dis_max/min keys, SURVEY §2.2 key list)
+            kappa_key = {"energy": "ene", "charge": "ch",
+                         "discharge": "dis"}.get(kind)
+            if self.use_slack and kappa_key is not None:
+                raw = self.scenario.get(f"kappa_{kappa_key}_{sense}")
+                # template default 100000; an explicit 0 means free slack
+                kappa = 1e5 if raw is None else float(raw)
+                sv = b.var(f"poi/slack_{kind}_{sense}", ctx.T,
+                           lb=0.0, ub=np.inf)
+                terms = terms + [(sv, 1.0 if sense == "min" else -1.0)]
+                b.add_cost(sv, kappa * ctx.annuity_scalar, label="Slack")
             b.add_rows(f"sysreq_{kind}_{sense}", terms,
                        "ge" if sense == "min" else "le", arr)
 

@@ -128,10 +128,3 @@ def make_windows(index: pd.DatetimeIndex, ts: pd.DataFrame, monthly,
         out.append(WindowContext(label=int(label), index=sub, ts=ts.loc[sub],
                                  monthly=monthly, dt=dt))
     return out
-
-
-def group_by_length(windows: List[WindowContext]) -> Dict[int, List[WindowContext]]:
-    groups: Dict[int, List[WindowContext]] = {}
-    for w in windows:
-        groups.setdefault(w.T, []).append(w)
-    return groups

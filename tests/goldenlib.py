@@ -35,11 +35,17 @@ def compare_proforma_results(inst, frozen_path, bound_pct: float):
     expected = pd.read_csv(frozen_path, index_col=0)
     got = inst.proforma_df.copy()
     got.index = [str(i) for i in got.index]
+    # column superset: every golden column with any non-zero value must be
+    # present in the result (all-zero columns may be absent — the reference
+    # emits a zero column where the repo omits the stream entirely)
+    missing = [c for c in expected.columns
+               if _ci_lookup(got.columns, c) is None
+               and not np.allclose(np.nan_to_num(
+                   expected[c].to_numpy(dtype=float)), 0.0)]
+    assert not missing, f"missing non-zero proforma columns {missing}"
     for col in expected.columns:
         gcol = _ci_lookup(got.columns, col)
         if gcol is None:
-            assert np.allclose(expected[col].to_numpy(dtype=float), 0.0), \
-                f"missing non-zero proforma column {col!r}"
             continue
         for idx in expected.index:
             exp = expected.loc[idx, col]
@@ -68,8 +74,13 @@ def compare_size_results(inst, frozen_path, bound_pct: float):
             if pd.isna(exp):
                 continue
             gcol = _ci_lookup(got.columns, col)
-            if gcol is None or pd.isna(got.loc[gder, gcol]):
-                continue
+            # a column the golden populates must exist and hold a value in
+            # the result (reference TestingLib.py:131-135 raises KeyError on
+            # a missing column; a NaN where the golden has a number is the
+            # same defect)
+            assert gcol is not None, f"missing size column {col!r}"
+            assert not pd.isna(got.loc[gder, gcol]), \
+                f"size[{der}, {col}] is NaN, expected {exp}"
             assert_within_error_bound(exp, got.loc[gder, gcol], bound_pct,
                                       f"size[{der}, {col}]:")
 

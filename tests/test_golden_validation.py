@@ -202,3 +202,78 @@ def test_post_facto_reliability_with_user_constraints():
                   base_path=REF).solve(backend="cpu").instances[0]
     assert "load_coverage_prob" in inst.drill_down_dict
     assert len(inst.time_series_data) == 8760
+
+
+# ---------------------------------------------------------------------------
+# Jax (TPU-path) backend validation at the NPV level (VERDICT r2 #1).
+#
+# The sizing usecases (Usecase1/3) route their single year-long sizing
+# window to the CPU exact solver BY DESIGN (scenario.py _solve routing:
+# one badly-scaled LP solved once vs the batched operational axis), and the
+# load-shedding cases are reliability-only (opt_engine=False — no dispatch
+# LP at all), so the frozen-golden cases that genuinely exercise the
+# batched PDHG dispatch path are the fixed-size economic-dispatch ones:
+# Usecase2 step2 (retail + DCM + User min-SOE floor, 12 monthly windows)
+# and the storagevet-features cases.  Strategy:
+#   * default suite: case 000 (DA + binary battery, 12 monthly windows,
+#     ~11 s) runs end-to-end on backend="jax" and must match the CPU
+#     backend at the NPV/proforma level within the BASELINE.md 1% gate;
+#   * --runslow: Usecase2 step2 on backend="jax" against the FROZEN
+#     reference proforma within 1% (the retail floor windows need ~300k
+#     PDHG iterations — seconds on TPU, minutes on the CPU test platform).
+# ---------------------------------------------------------------------------
+
+SV = REF / "test/test_storagevet_features/model_params"
+
+
+class TestJaxBackendNPV:
+    """Batched PDHG dispatch must reproduce exact-solver economics."""
+
+    @pytest.fixture(scope="class")
+    def pair(self):
+        jx = DERVET(SV / "000-DA_battery_month.csv",
+                    base_path=REF).solve(backend="jax").instances[0]
+        cp = DERVET(SV / "000-DA_battery_month.csv",
+                    base_path=REF).solve(backend="cpu").instances[0]
+        return jx, cp
+
+    def test_jax_dispatch_actually_ran(self, pair):
+        jx, _ = pair
+        meta = jx.scenario.solve_metadata
+        assert meta["backend"] == "jax"
+        assert meta["batched_solves"] >= 1 and meta["n_windows"] == 12
+
+    def test_npv_within_1pct(self, pair):
+        jx, cp = pair
+        assert jx.npv_df is not None and cp.npv_df is not None
+        for col in cp.npv_df.columns:
+            exp = float(cp.npv_df[col].iloc[0])
+            got = float(jx.npv_df[col].iloc[0])
+            if abs(exp) < 1.0:
+                assert abs(got - exp) < 1.0, (col, exp, got)
+            else:
+                assert abs(got - exp) / abs(exp) < 0.01, (col, exp, got)
+
+    def test_proforma_within_1pct(self, pair):
+        jx, cp = pair
+        exp_df, got_df = cp.proforma_df, jx.proforma_df
+        assert sorted(exp_df.columns) == sorted(got_df.columns)
+        for col in exp_df.columns:
+            for idx in exp_df.index:
+                exp, got = float(exp_df.loc[idx, col]), float(got_df.loc[idx, col])
+                if abs(exp) < 1.0:
+                    assert abs(got - exp) < 1.0, (idx, col, exp, got)
+                else:
+                    assert abs(got - exp) / abs(exp) < 0.01, (idx, col, exp, got)
+
+
+@pytest.mark.slow
+def test_usecase2_step2_jax_proforma_golden():
+    """UC2 step2 on the jax backend vs the FROZEN reference proforma:
+    dispatch-dependent avoided demand + energy charges within 1%."""
+    d = DERVET(UC2 / "Model_Parameters_Template_Usecase3_Planned_ES_Step2.csv",
+               base_path=REF)
+    inst = d.solve(backend="jax").instances[0]
+    assert inst.scenario.solve_metadata["backend"] == "jax"
+    compare_proforma_results(
+        inst, RES2 / "es/step2/pro_formauc3_es_step2.csv", 1.0)

@@ -1,0 +1,55 @@
+"""Output file-set audit (VERDICT r2 #8): the CSV set written for a case
+must cover the reference's frozen results directory file-for-file, and
+multi-case runs must write sensitivity_summary.csv (reference:
+storagevet.Result.sensitivity_summary written from dervet/DERVET.py:85)."""
+from pathlib import Path
+
+import pandas as pd
+import pytest
+
+from dervet_tpu.api import DERVET
+
+REF = Path("/root/reference")
+
+
+def _stems(directory, suffix):
+    return {p.name[: -len(suffix) - 4] for p in directory.glob(f"*{suffix}.csv")}
+
+
+def test_file_set_covers_reference_load_shedding(tmp_path):
+    """The reference's wo_ls1 sizing frozen dir is the checklist: every
+    file name it contains must be produced (with our label) for the same
+    input."""
+    res = DERVET(REF / "test/test_load_shedding/mp/Sizing/"
+                 "Model_Parameters_Template_DER_wo_ls1.csv",
+                 base_path=REF).solve(backend="cpu")
+    res.save_as_csv(tmp_path)
+    expected = _stems(
+        REF / "test/test_load_shedding/results/Sizing/wo_ls1", "_2mw_5hr")
+    got = {p.stem for p in tmp_path.glob("*.csv")}
+    missing = expected - got
+    assert not missing, f"missing output files: {sorted(missing)}"
+
+
+def test_sensitivity_summary_csv_written(tmp_path):
+    """A 4-case sensitivity run writes one summary row per case with the
+    swept parameter and the lifetime NPV."""
+    res = DERVET(REF / "test/test_storagevet_features/model_params/"
+                 "009-bat_energy_sensitivity.csv",
+                 base_path=REF).solve(backend="cpu")
+    res.save_as_csv(tmp_path)
+    f = tmp_path / "sensitivity_summary.csv"
+    assert f.exists()
+    df = pd.read_csv(f, index_col="Case")
+    assert len(df) == 4
+    assert "Battery/ene_max_rated" in df.columns
+    assert "Lifetime Net Present Value" in df.columns
+    assert df["Lifetime Net Present Value"].notna().all()
+
+
+def test_single_case_writes_no_sensitivity_summary(tmp_path):
+    res = DERVET(REF / "test/test_storagevet_features/model_params/"
+                 "000-DA_battery_month.csv", base_path=REF).solve(
+        backend="cpu")
+    res.save_as_csv(tmp_path)
+    assert not (tmp_path / "sensitivity_summary.csv").exists()

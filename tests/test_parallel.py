@@ -124,3 +124,50 @@ def test_monte_carlo_multi_der_sharded():
     assert int(stats.n_converged) == 16
     np.testing.assert_allclose(np.asarray(res_sh.obj), np.asarray(res.obj),
                                rtol=2e-4, atol=1e-3)
+
+
+class TestCrossCaseBatching:
+    """VERDICT r2 #3: sensitivity cases batch their same-structure windows
+    into shared device calls (sharded over the 8-device CPU mesh here), and
+    the batched results equal the serial per-case path."""
+
+    @pytest.fixture(scope="class")
+    def batched(self):
+        from pathlib import Path
+        from dervet_tpu.api import DERVET
+        REF = Path("/root/reference")
+        d = DERVET(REF / "test/test_storagevet_features/model_params/"
+                   "009-bat_energy_sensitivity.csv", base_path=REF)
+        return d.solve(backend="jax")
+
+    def test_four_cases_batch_into_shared_groups(self, batched):
+        insts = batched.instances
+        assert len(insts) == 4
+        for inst in insts.values():
+            meta = inst.scenario.solve_metadata
+            # 4 cases x 12 monthly windows collapse into the 3 month-length
+            # structure groups (31/30/28 days) DISPATCH-WIDE — if cross-case
+            # sharing broke (e.g. the swept parameter started entering K),
+            # this would read 12 per-case groups instead
+            assert meta["dispatch_groups_total"] == 3, meta
+            assert meta["structure_groups_total"] == 3, meta
+            assert meta["n_windows"] == 12
+
+    def test_batched_matches_serial_cpu(self, batched):
+        from pathlib import Path
+        from dervet_tpu.io.params import Params
+        from dervet_tpu.scenario.scenario import MicrogridScenario
+        REF = Path("/root/reference")
+        cases = Params.initialize(
+            REF / "test/test_storagevet_features/model_params/"
+            "009-bat_energy_sensitivity.csv", base_path=REF)
+        for key, inst in batched.instances.items():
+            serial = MicrogridScenario(cases[key])
+            serial.optimize_problem_loop(backend="cpu")
+            oj = inst.scenario.objective_values
+            oc = serial.objective_values
+            assert set(oj) == set(oc)
+            for k in oj:
+                a = oj[k]["Total Objective"]
+                b = oc[k]["Total Objective"]
+                assert abs(a - b) / max(abs(b), 1.0) < 1e-3, (key, k, a, b)

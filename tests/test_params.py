@@ -25,6 +25,9 @@ KNOWN_UNLOADABLE = {
     "002-catch_wrong_length.csv",                # reference expects this to
                                                  # raise: evaluation list vs
                                                  # sensitivity length mismatch
+    "109-carrying_cost_d_is_e_error.csv",        # reference expects a raise
+                                                 # (analysis_horizon_mode=4 is
+                                                 # outside allowed 1|2|3)
     "004-cba_valuation_coupled_dt.csv",          # 000-011-timeseries_5min_2017.csv missing
     "Model_Parameters_Template_DER_PoSD.csv",    # .\Testing\... datasets absent
     "Model_Parameters_Template_DER_PoSD_deferral.csv",
@@ -122,3 +125,42 @@ def test_opt_years_not_in_monthly_data():
             "039-mutli_opt_years_not_in_monthly_data.csv")
     with pytest.raises(MonthlyDataError):
         DERVET(path, base_path=REF).solve(backend="cpu")
+
+
+# ---------------------------------------------------------------------------
+# Allowed-Values enforcement (VERDICT r2 #5): out-of-range / out-of-set
+# inputs are rejected like the reference's per-key Schema validation
+# (reference: dervet/Schema.json allowed_values/min/max metadata enforced
+# through DERVETParams' validation path).
+# ---------------------------------------------------------------------------
+
+def _template_with(tmp_path, tag, key, value):
+    import pandas as pd
+    df = pd.read_csv(REF / "Model_Parameters_Template_DER.csv")
+    sel = (df.Tag == tag) & (df.Key == key)
+    assert sel.any(), (tag, key)
+    df.loc[sel, "Optimization Value"] = value
+    out = tmp_path / "mp.csv"
+    df.to_csv(out, index=False)
+    return out
+
+
+@pytest.mark.parametrize("tag,key,value", [
+    ("Scenario", "binary", "2"),            # bool outside {0,1}
+    ("Scenario", "ownership", "shared"),    # not in customer|utility|3rd party
+    ("Battery", "rte", "110"),              # % above max 100
+    ("Battery", "macrs_term", "6"),         # not an IRS MACRS term
+    ("Finance", "analysis_horizon_mode", "4"),   # allowed 1|2|3
+    ("Battery", "salvage_value", "bogus words"),  # not a number or mode
+])
+def test_allowed_values_rejected(tmp_path, tag, key, value):
+    with pytest.raises(ModelParameterError):
+        Params.initialize(_template_with(tmp_path, tag, key, value),
+                          base_path=REF)
+
+
+def test_allowed_values_accepted(tmp_path):
+    """In-range edits still load: numeric salvage, allowed ownership."""
+    path = _template_with(tmp_path, "Battery", "salvage_value", "5000")
+    cases = Params.initialize(path, base_path=REF)
+    assert len(cases) == 1
