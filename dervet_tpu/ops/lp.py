@@ -255,6 +255,25 @@ class LPBuilder:
             shape=(m, n),
         ).tocsr()
         q = np.concatenate(q_parts) if q_parts else np.zeros(0)
+        # Presolve: tighten never-binding inequality rhs to each row's own
+        # activity lower bound.  Input data carries "no limit" sentinels
+        # (the reference datasets use 999999-style placeholders; our
+        # requirement fills use 1e30) that an exact simplex ignores but
+        # that dominate ||q||_2 and poison the PDHG solver's RELATIVE
+        # termination criterion (residual <= eps_rel * ||q||) — a window
+        # can then "converge" with kWh-scale physical violations.  For a
+        # 'ge' row, min_x K_row @ x over the box [l, u] is
+        # sum_j min(K_ij*l_j, K_ij*u_j); if q_i is below that, the row can
+        # never bind and raising q_i to the bound is exact.
+        if m > n_eq:
+            Kge = K[n_eq:]
+            pos = Kge.multiply(Kge > 0)
+            neg = Kge.multiply(Kge < 0)
+            act_min = np.asarray(pos @ l + neg @ u).ravel()
+            qi = q[n_eq:]
+            with np.errstate(invalid="ignore"):
+                q[n_eq:] = np.where(np.isfinite(act_min),
+                                    np.maximum(qi, act_min), qi)
         integrality = None
         if self._binary:
             integrality = np.zeros(n, np.int8)

@@ -61,6 +61,51 @@ class TestLPBuilder:
         np.testing.assert_allclose(lp.dense_K(), -np.eye(3))
         np.testing.assert_allclose(lp.q, -5.0)
 
+    def test_presolve_clamps_never_binding_rhs(self):
+        """Sentinel "no limit" values (the reference datasets use 999999,
+        our requirement fills 1e30) must not survive into q: they inflate
+        ||q||_2 and poison the PDHG relative termination criterion.  A
+        never-binding 'le' rhs is clamped to the row's activity bound; a
+        binding rhs is untouched; rows touching unbounded variables are
+        left alone."""
+        b = LPBuilder()
+        x = b.var("x", 2, 0.0, 10.0)
+        f = b.var("free", 1)                      # unbounded
+        b.add_rows("never", [(x, 1.0)], "le", 999999.0)   # max activity 10
+        b.add_rows("binds", [(x, 1.0)], "le", 5.0)
+        b.add_rows("unbounded", [(f, np.ones((1, 1)))], "le", 999999.0)
+        lp = b.build()
+        rows = {name: r[0] for name, r in lp.row_groups.items()}
+        a, _ = rows["never"]
+        # 'le' rows are negated to 'ge': q = -rhs, clamped up to -10
+        np.testing.assert_allclose(lp.q[a:a + 2], -10.0)
+        a, _ = rows["binds"]
+        np.testing.assert_allclose(lp.q[a:a + 2], -5.0)
+        a, _ = rows["unbounded"]
+        np.testing.assert_allclose(lp.q[a], -999999.0)
+
+    def test_presolve_keeps_problem_equivalent(self):
+        """Solving with a sentinel-polluted extra row gives the same
+        optimum as without it (HiGHS)."""
+        from dervet_tpu.ops.cpu_ref import solve_lp_cpu
+        lp_plain = battery_like_lp(T=24)
+        b = LPBuilder()
+        ch = b.var("ch", 24, 0.0, 250.0)
+        dis = b.var("dis", 24, 0.0, 250.0)
+        ene = b.var("ene", 24, 0.0, 1000.0)
+        D = np.eye(24) - np.eye(24, k=-1)
+        rhs = np.zeros(24)
+        rhs[0] = 500.0
+        b.add_rows("soe", [(ene, D), (ch, -0.85), (dis, 1.0)], "eq", rhs)
+        rng = np.random.default_rng(1)
+        price = rng.uniform(10, 80, 24) / 1000
+        b.add_cost(ch, price)
+        b.add_cost(dis, -price)
+        b.add_rows("sentinel_cap", [(ene, 1.0)], "le", 999999.0)
+        lp_sent = b.build()
+        assert np.abs(lp_sent.q).max() <= 1000.0    # clamped to activity
+        assert abs(solve_lp_cpu(lp_sent).obj - solve_lp_cpu(lp_plain).obj) < 1e-9
+
 
 class TestPDHGvsHiGHS:
     @pytest.mark.parametrize("seed", [0, 1, 2])
