@@ -25,6 +25,25 @@ class DERVET:
         self.verbose = verbose
         self.cases: Dict[int, CaseParams] = Params.initialize(
             model_parameters_path, base_path=base_path, verbose=verbose)
+        # Results.errors_log_path routes the run log to a file (reference:
+        # the ErrorHandling log file configured from the Results tag)
+        log_dir = str(self.cases[min(self.cases)].results.get(
+            "errors_log_path") or "").strip()
+        if log_dir and log_dir not in (".", "nan"):
+            if " " in log_dir and "/" not in log_dir and "\\" not in log_dir:
+                # the canonical template ships placeholder prose here
+                # ("Enter absolute path here (include the folder ...)") —
+                # spaces without any path separator; real paths with
+                # spaces carry separators and pass through
+                TellUser.warning(f"errors_log_path {log_dir!r} does not "
+                                 "look like a path — no error log written")
+            else:
+                try:
+                    TellUser.attach_file(Path(log_dir),
+                                         name="errors_log.log")
+                except OSError as e:
+                    TellUser.warning(f"could not open errors_log_path "
+                                     f"{log_dir!r}: {e}")
         TellUser.info(f"Initialized {len(self.cases)} case(s) from "
                       f"{model_parameters_path}")
 

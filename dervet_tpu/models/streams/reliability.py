@@ -112,6 +112,62 @@ def _simulate_all_outages(crit: jax.Array, gen: jax.Array, pv_max: jax.Array,
     return coverage, jnp.transpose(profiles)
 
 
+def _min_soe_required(crit: jax.Array, gen: jax.Array, pv_max: jax.Array,
+                      pv_vari: jax.Array, gamma: float, shed: jax.Array,
+                      ch_max: float, dis_max: float, e_min: float,
+                      e_max: float, rte: float, dt: float, L: int):
+    """EXACT minimal initial SOE per outage start (vmapped backward
+    recursion).
+
+    TPU-native equivalent of the reference's exact ``min_soe_opt``
+    (Reliability.py:572-683): that MILP is separable per outage start —
+    each start's sub-problem shares no variables with the others — and for
+    the aggregate single-state ESS model the per-start optimum has a
+    closed-form backward recursion: walking outage steps last-to-first,
+    ``m[j]`` is the least SOE at step j from which steps j..L-1 are
+    survivable.  Deficit steps must discharge the full net load (so
+    ``m[j] = max(e_min + dl*dt, ec*dt, m[j+1] + dl*dt)``, infeasible when
+    ``dl`` exceeds the discharge rating); surplus steps may charge up to
+    ``min(-dl, ch_max)`` (so ``m[j] = max(e_min, m[j+1] - charge)``,
+    infeasible when ``m[j+1]`` exceeds the energy cap).  One
+    ``lax.scan`` over L steps evaluates every start simultaneously —
+    replacing T_month x one-LP-per-start MILPs with L fused vector steps.
+    Branch thresholds use the same 5-decimal rounding as the forward walk
+    so exact and simulated feasibility agree.
+    """
+    T = crit.shape[0]
+    starts = jnp.arange(T)
+
+    def _round5(x):
+        return jnp.round(x * 1e5) / 1e5
+
+    def step(m_next, j):
+        idx = starts + j
+        in_range = idx < T
+        idxc = jnp.minimum(idx, T - 1)
+        load = crit[idxc] * shed[j]
+        rc = _round5(load - gen[idxc] - pv_vari[idxc])
+        dl = _round5(load - gen[idxc] - pv_max[idxc])
+        ec = rc * gamma
+        # deficit: the ESS must discharge the full net load dl
+        feas = dl <= dis_max + 1e-9
+        m_deficit = jnp.maximum(jnp.maximum(e_min + dl * dt, ec * dt),
+                                m_next + dl * dt)
+        m_deficit = jnp.where(feas, m_deficit, jnp.inf)
+        # surplus: optional charging helps reach the NEXT requirement
+        chg = jnp.maximum(jnp.minimum(-dl, ch_max), 0.0) * rte * dt
+        m_surplus = jnp.maximum(e_min, m_next - chg)
+        m_surplus = jnp.where(m_next <= e_max + 1e-9, m_surplus, jnp.inf)
+        m = jnp.where(rc <= 0.0, m_surplus, m_deficit)
+        # outage truncated at the horizon end: no requirement beyond it
+        m = jnp.where(in_range, m, e_min)
+        return m, None
+
+    m0, _ = jax.lax.scan(step, jnp.full(T, float(e_min)),
+                         jnp.arange(L - 1, -1, -1))
+    return m0
+
+
 class Reliability(ValueStream):
     """Microgrid islanding reliability (dervet Reliability tag)."""
 
@@ -125,6 +181,11 @@ class Reliability(ValueStream):
         self.max_outage_duration = g("max_outage_duration",
                                      self.outage_duration or 1)
         self.n_2 = bool(keys.get("n-2", False))
+        # exact per-start minimal-SOE schedule (the reference's min_soe_opt
+        # exact mode, Reliability.py:572-683 — commented out of its own
+        # default path at :215-217); opt-in extension key, default keeps
+        # the reference's default iterative method
+        self.min_soe_exact = bool(keys.get("min_soe_exact", False))
         self.load_shed = bool(keys.get("load_shed_percentage", False))
         self.load_shed_data: Optional[np.ndarray] = None
         if self.load_shed:
@@ -420,6 +481,23 @@ class Reliability(ValueStream):
         if p["energy rating"] <= 0:
             return None
         L = self.coverage_steps
+        if self.min_soe_exact:
+            req = np.asarray(_min_soe_required(
+                jnp.asarray(self.critical_load.to_numpy()),
+                jnp.asarray(mix["gen"]), jnp.asarray(mix["pv_max"]),
+                jnp.asarray(mix["pv_vari"]), mix["gamma"],
+                jnp.asarray(self._shed_curve(L)),
+                p["charge max"], p["discharge max"], p["soe min"],
+                p["soe max"], p["rte"], self.dt, L))
+            n_bad = int(np.sum(req > p["soe max"] + 1e-6))
+            if n_bad:
+                TellUser.warning(
+                    f"min_soe_exact: {n_bad} outage start(s) are not "
+                    "coverable at any state of energy — requirement capped "
+                    "at the fleet energy limit")
+            self.min_soe_df = pd.DataFrame(
+                {"soe": np.minimum(req, p["soe max"])}, index=index)
+            return self.min_soe_df
         init = np.full(len(index), self.soc_init * p["energy rating"])
         cov, prof = self._walk(mix, init, L)
         # profile incl. the initial soe at the front

@@ -42,8 +42,19 @@ class TellUser:
 
     @classmethod
     def attach_file(cls, results_dir: Path, name: str = "dervet_tpu.log") -> None:
+        """Route the log to a file; one run-log file at a time — a second
+        attach with a different path replaces the first (sequential runs
+        in one process must not cross-write each other's logs)."""
         results_dir.mkdir(parents=True, exist_ok=True)
-        fh = logging.FileHandler(results_dir / name)
+        target = str((results_dir / name).resolve())
+        for h in list(cls.logger.handlers):
+            if getattr(h, "_dervet_run_log", False):
+                if h.baseFilename == target:
+                    return
+                cls.logger.removeHandler(h)
+                h.close()
+        fh = logging.FileHandler(target)
+        fh._dervet_run_log = True
         fh.setFormatter(logging.Formatter("%(asctime)s %(levelname)s: %(message)s"))
         cls.logger.addHandler(fh)
 
