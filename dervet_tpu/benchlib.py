@@ -92,14 +92,89 @@ def synthetic_case(year: int = 2017, n="month", dt: float = 1.0,
     )
 
 
-def build_window_lps(case: CaseParams) -> Tuple[MicrogridScenario,
-                                                Dict[int, List[LP]]]:
-    """Assemble every optimization window's LP, grouped by window length."""
+def build_window_lps(case: CaseParams, pad_to_max: bool = False
+                     ) -> Tuple[MicrogridScenario, Dict[int, List[LP]]]:
+    """Assemble every optimization window's LP, grouped by window length.
+
+    ``pad_to_max=True`` (a BENCH-ONLY experiment, ``BENCH_FUSE=1``)
+    extends every shorter window with inert steps up to the longest
+    window's length so all windows share one byte-identical constraint
+    structure — the 28/30/31-day monthly groups collapse into a single
+    batched solve.  Exactness of the padding (asserted vs HiGHS in
+    tests/test_pdhg.py) relies on padded steps being truly inert, which
+    holds only for the synthetic bench family: no self-discharge (the
+    tail SOE pin needs ene[t+1]==ene[t]), no fixed O&M / house power
+    (constants scale with window length), no EV sessions or
+    calendar-month-keyed streams (their structure would diverge across
+    the padded boundary).  Guarded below; measured on-chip it is a wash
+    vs the unfused path (PERF.md), so nothing routes here by default."""
+    import dataclasses
+
     scen = MicrogridScenario(case)
+    windows = scen.windows
+    if pad_to_max:
+        for d in scen.ders:
+            bad = [a for a in ("sdr", "hp", "fixed_om_per_kw", "fixed_om")
+                   if getattr(d, a, 0)]
+            if bad or d.tag.startswith("ElectricVehicle"):
+                raise ValueError(
+                    f"pad_to_max: {d.name} has {bad or 'EV sessions'} — "
+                    "padded steps would not be inert")
+        cal_keyed = {"DCM", "retailTimeShift"} & set(scen.streams)
+        if cal_keyed:
+            raise ValueError(f"pad_to_max: {sorted(cal_keyed)} key their "
+                             "structure by calendar month — padding would "
+                             "diverge across the boundary")
+        T_max = max(ctx.T for ctx in windows)
+        freq = pd.Timedelta(hours=scen.dt)
+
+        def pad(ctx):
+            extra = T_max - ctx.T
+            if extra <= 0:
+                return ctx
+            ext = pd.date_range(ctx.index[-1] + freq, periods=extra,
+                                freq=freq)
+            ts = pd.concat([ctx.ts,
+                            pd.DataFrame(0.0, index=ext,
+                                         columns=ctx.ts.columns)])
+            return dataclasses.replace(ctx, index=ts.index, ts=ts)
+
+        real_T = {ctx.label: ctx.T for ctx in windows}
+        windows = [pad(ctx) for ctx in windows]
     groups: Dict[int, List[LP]] = {}
-    for ctx in scen.windows:
+    for ctx in windows:
         lp = scen.build_window_lp(ctx)
+        if pad_to_max and ctx.T > real_T[ctx.label]:
+            # padded steps must be INERT: every dispatch variable pins to
+            # zero there (otherwise the window-exit SOE pin moves past the
+            # real month and the battery refills for free at the padded
+            # zero price).  SOE itself stays free — with dispatch zeroed
+            # it is constant through the tail, so the exit pin constrains
+            # the real month exactly like the unpadded window.
+            start = real_T[ctx.label]
+            for name, ref in lp.var_refs.items():
+                if ref.size == ctx.T and not name.endswith("/ene"):
+                    lp.l[ref.sl][start:] = 0.0
+                    lp.u[ref.sl][start:] = 0.0
+            # the tail SOE is fully determined (dispatch zeroed + exit pin
+            # = window target); pinning its bounds removes the cost-free
+            # floating block that otherwise stalls PDHG's duals
+            for der in scen.ders:
+                target = getattr(der, "ene_target", None)
+                if target is None:
+                    continue
+                name = der.vname("ene")
+                if name in lp.var_refs:
+                    sl = lp.var_refs[name].sl
+                    lp.l[sl][start:] = target
+                    lp.u[sl][start:] = target
         groups.setdefault(ctx.T, []).append(lp)
+    if pad_to_max:
+        (lps,) = groups.values()
+        keys = {MicrogridScenario._structure_key(lp) for lp in lps}
+        if len(keys) != 1:
+            raise ValueError("pad_to_max: padded windows did not collapse "
+                             "to one constraint structure")
     return scen, groups
 
 

@@ -231,3 +231,25 @@ class TestInfeasibilityCertificate:
         lp = battery_like_lp(T=48)
         res = CompiledLPSolver(lp).solve()
         assert int(res.status) == STATUS_CONVERGED
+
+
+def test_window_fusion_padding_exact():
+    """build_window_lps(pad_to_max=True) collapses the monthly length
+    groups into one byte-identical structure WITHOUT changing any
+    window's optimum: padded steps pin dispatch to zero and the tail SOE
+    to the window target, so the exit pin constrains the real month
+    exactly like the unpadded window."""
+    from dervet_tpu.benchlib import build_window_lps, synthetic_case
+    from dervet_tpu.ops.cpu_ref import solve_lp_cpu
+    from dervet_tpu.scenario.scenario import MicrogridScenario
+
+    _, fused = build_window_lps(synthetic_case(), pad_to_max=True)
+    assert list(fused) == [744] and len(fused[744]) == 12
+    keys = {MicrogridScenario._structure_key(lp) for lp in fused[744]}
+    assert len(keys) == 1
+    s = MicrogridScenario(synthetic_case())
+    # February (shortest) and April (30-day): the two padded lengths
+    for label in (1, 3):
+        plain = solve_lp_cpu(s.build_window_lp(s.windows[label])).obj
+        padded = solve_lp_cpu(fused[744][label]).obj
+        assert abs(plain - padded) / max(1.0, abs(plain)) < 1e-9
