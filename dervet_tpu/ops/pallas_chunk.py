@@ -1,0 +1,153 @@
+"""Pallas TPU kernel: a fused PDHG iteration chunk with VMEM-resident state.
+
+Why this exists (measured, PERF.md): at dispatch-LP shapes the batched
+solver is HBM-bound on the ITERATE traffic — XLA keeps the while/scan
+carries (x, y, x_sum, y_sum) in HBM, so every PDHG iteration re-reads and
+re-writes ~2(n+m) floats per instance plus the problem data.  This kernel
+runs ``check_every`` iterations per device call with everything resident
+in VMEM: per grid step it loads one block of instances (state + c/q/l/u),
+keeps the scaled constraint matrix K resident, iterates with MXU matmuls,
+and writes the state back once — amortizing the HBM round-trip over the
+whole chunk.
+
+Layout per grid step (VMEM ~16 MB/core on v5e):
+  * K (m, n) f32, shared across the batch — resident, constant index map;
+  * a (BLK, ·) block of {c, l, u, x, x_sum} in x-space and
+    {q, y, y_sum} in y-space, BLK sized so K + block fits VMEM;
+  * the two matvecs are (BLK, m) @ (m, n) and (BLK, n) @ (n, m) MXU
+    matmuls at ``precision=HIGHEST`` (bf16 multi-pass f32 — DEFAULT
+    diverges, PERF.md "Solver precision").
+
+The kernel implements EXACTLY ``one_iter`` from ops/pdhg.py (same update,
+same projection), so the restart/convergence logic upstream is untouched;
+it plugs in through a ``jax.custom_batching.custom_vmap`` rule — the
+unbatched path keeps the reference ``lax.scan``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# K must stay VMEM-resident next to the instance block; above this size
+# fall back to the XLA scan path (v5e VMEM is ~16 MB/core)
+MAX_K_BYTES = 10 * 1024 * 1024
+BLK = 128         # instances per grid step: full MXU tile rows
+
+
+def _chunk_kernel(iters: int,
+                  c_ref, q_ref, l_ref, u_ref, tau_ref, sig_ref,
+                  x_ref, y_ref, xs_ref, ys_ref, k_ref, fl_ref,
+                  xo_ref, yo_ref, xso_ref, yso_ref):
+    K = k_ref[...]                   # (m, n) scaled constraint matrix
+    fl = fl_ref[...]                 # (1, m): -inf on eq rows, 0 on ge
+    c = c_ref[...]
+    q = q_ref[...]
+    l = l_ref[...]
+    u = u_ref[...]
+    tau = tau_ref[...]               # (BLK, 1) = eta / omega
+    sig = sig_ref[...]               # (BLK, 1) = eta * omega
+    hi = jax.lax.Precision.HIGHEST
+
+    def it(_, carry):
+        x, y, xs, ys = carry
+        # grad = c - K^T y   -> (BLK, m) @ (m, n)
+        ky = jax.lax.dot_general(y, K, (((1,), (0,)), ((), ())),
+                                 precision=hi,
+                                 preferred_element_type=jnp.float32)
+        x1 = jnp.clip(x - tau * (c - ky), l, u)
+        # K (2 x1 - x)      -> (BLK, n) @ (n, m) via contraction on n
+        kx = jax.lax.dot_general(2.0 * x1 - x, K, (((1,), (1,)), ((), ())),
+                                 precision=hi,
+                                 preferred_element_type=jnp.float32)
+        y1 = jnp.maximum(y + sig * (q - kx), fl)
+        return x1, y1, xs + x1, ys + y1
+
+    x, y, xs, ys = jax.lax.fori_loop(
+        0, iters, it, (x_ref[...], y_ref[...], xs_ref[...], ys_ref[...]))
+    xo_ref[...] = x
+    yo_ref[...] = y
+    xso_ref[...] = xs
+    yso_ref[...] = ys
+
+
+@functools.lru_cache(maxsize=32)
+def _build_call(m: int, n: int, iters: int, grid: int):
+    blk_x = pl.BlockSpec((BLK, n), lambda i: (i, 0))
+    blk_y = pl.BlockSpec((BLK, m), lambda i: (i, 0))
+    blk_s = pl.BlockSpec((BLK, 1), lambda i: (i, 0))
+    shared_k = pl.BlockSpec((m, n), lambda i: (0, 0))
+    shared_f = pl.BlockSpec((1, m), lambda i: (0, 0))
+    return pl.pallas_call(
+        functools.partial(_chunk_kernel, iters),
+        grid=(grid,),
+        # the default scoped-VMEM cap (16 MB) rejects K + one sub-batch of
+        # operands even though they fit the chip's physical VMEM; raise it
+        # for this call only
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        in_specs=[blk_x, blk_y, blk_x, blk_x, blk_s, blk_s,
+                  blk_x, blk_y, blk_x, blk_y, shared_k, shared_f],
+        out_specs=[blk_x, blk_y, blk_x, blk_y],
+        out_shape=[
+            jax.ShapeDtypeStruct((grid * BLK, n), jnp.float32),
+            jax.ShapeDtypeStruct((grid * BLK, m), jnp.float32),
+            jax.ShapeDtypeStruct((grid * BLK, n), jnp.float32),
+            jax.ShapeDtypeStruct((grid * BLK, m), jnp.float32),
+        ],
+    )
+
+
+# set by CompiledLPSolver's runtime fallback when the kernel fails to
+# compile on this backend (e.g. the scoped-VMEM flag did not reach libtpu
+# before backend init) — later solvers then skip the kernel entirely
+RUNTIME_DISABLED = False
+
+
+def supports(op, dtype, precision=None, backend: Optional[str] = None) -> bool:
+    """Static gate: dense op, f32 at HIGHEST precision, on a real TPU
+    backend, K fits VMEM.  The kernel hardcodes HIGHEST matmuls (DEFAULT
+    diverges, PERF.md), so any other requested precision stays on the
+    scan path, which honors it."""
+    from .pdhg import DenseOp
+    if RUNTIME_DISABLED:
+        return False
+    if precision is not None and precision != jax.lax.Precision.HIGHEST:
+        return False
+    if backend is None:
+        backend = jax.default_backend()
+    if backend != "tpu" or dtype != jnp.float32:
+        return False
+    if not isinstance(op, DenseOp):
+        return False
+    mm, nn = op.Kh.shape
+    return mm * nn * 4 <= MAX_K_BYTES
+
+
+def batched_chunk(op, c, q, l, u, omega, eta, x, y, xs, ys,
+                  n_eq: int, iters: int):
+    """Run ``iters`` PDHG iterations for a whole batch via the fused
+    kernel.  All data args are (B, ·); omega is (B,)."""
+    B = x.shape[0]
+    m, n = op.Kh.shape
+    grid = -(-B // BLK)
+    pad = grid * BLK - B
+
+    def p(a):
+        return jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1)) if pad else a
+
+    tau = (eta / omega)[:, None].astype(jnp.float32)
+    sig = (eta * omega)[:, None].astype(jnp.float32)
+    floor = jnp.where(jnp.arange(m) < n_eq, -jnp.inf, 0.0)[None, :] \
+        .astype(jnp.float32)
+    call = _build_call(m, n, iters, grid)
+    xo, yo, xso, yso = call(p(c), p(q), p(l), p(u), p(tau), p(sig),
+                            p(x), p(y), p(xs), p(ys), op.Kh, floor)
+    if pad:
+        xo, yo, xso, yso = (a[:B] for a in (xo, yo, xso, yso))
+    return xo, yo, xso, yso

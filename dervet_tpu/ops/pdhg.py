@@ -58,6 +58,17 @@ if not os.environ.get("DERVET_TPU_NO_XLA_CACHE"):
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
     except Exception:                       # never let caching break solves
         pass
+# The fused Pallas chunk kernel (ops/pallas_chunk.py) needs more scoped
+# VMEM than libtpu's 16 MB default (XLA promotes the whole call's
+# operands); the flag only takes effect if it reaches libtpu BEFORE the
+# backend initializes — importing this module early (any dervet_tpu use)
+# is normally enough.  If the backend was already up, the runtime
+# fallback in CompiledLPSolver handles it.
+if "--xla_tpu_scoped_vmem_limit_kib" not in os.environ.get(
+        "LIBTPU_INIT_ARGS", ""):
+    os.environ["LIBTPU_INIT_ARGS"] = (
+        os.environ.get("LIBTPU_INIT_ARGS", "")
+        + " --xla_tpu_scoped_vmem_limit_kib=100000").strip()
 import numpy as np
 
 from .lp import LP
@@ -243,6 +254,10 @@ class PDHGOptions:
     inaccurate_factor: float = 10.0
     # switch K to ELLPACK above this dense-size threshold
     dense_bytes_limit: int = 32 * 1024 * 1024
+    # run the iteration chunk as a fused Pallas kernel with VMEM-resident
+    # state when supported (TPU backend, dense op small enough to keep K
+    # in VMEM); transparent fallback to the XLA scan path otherwise
+    pallas_chunk: bool = True
     # iterations per device call: the host loops chunks until convergence.
     # Bounding each XLA program keeps single long solves from hitting
     # runtime watchdogs (a 100k-iteration year-long LP is minutes of
@@ -391,6 +406,45 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int, axis=None):
         y1 = jnp.where(eq_mask, y1, jnp.maximum(y1, 0.0))
         return (x1, y1, x_sum + x1, y_sum + y1), None
 
+    def _scan_chunk(op, c, q, l, u, omega, eta, x, y, xs, ys):
+        """``check_every`` iterations via lax.scan (the reference path)."""
+        eq_mask = (op.eq_mask if isinstance(op, ShardRowOp)
+                   else jnp.arange(m) < n_eq)
+        (x1, y1, xs1, ys1), _ = jax.lax.scan(
+            functools.partial(one_iter, op=op, c=c, q=q, l=l, u=u,
+                              eq_mask=eq_mask, omega=omega, eta=eta),
+            (x, y, xs, ys), None, length=opts.check_every)
+        return x1, y1, xs1, ys1
+
+    if axis is None and opts.pallas_chunk:
+        # batched solves swap the scan for the fused Pallas chunk kernel
+        # (ops/pallas_chunk.py) via a custom vmap rule: HBM traffic on the
+        # iterate carries drops ~check_every-fold.  The kernel implements
+        # one_iter verbatim, so restarts/termination upstream are
+        # untouched; anything unsupported falls back to vmap-of-scan.
+        chunk_fn = jax.custom_batching.custom_vmap(_scan_chunk)
+
+        @chunk_fn.def_vmap
+        def _chunk_vmap_rule(axis_size, in_batched, op, c, q, l, u,
+                             omega, eta, x, y, xs, ys):
+            from . import pallas_chunk
+            op_batched = any(jax.tree.leaves(in_batched[0]))
+            plain = (not op_batched and all(in_batched[1:6])
+                     and not in_batched[6] and all(in_batched[7:]))
+            if plain and pallas_chunk.supports(op, opts.dtype,
+                                               opts.precision):
+                out = pallas_chunk.batched_chunk(
+                    op, c, q, l, u, omega, eta, x, y, xs, ys,
+                    n_eq, opts.check_every)
+            else:
+                in_axes = tuple(jax.tree.map(lambda b: 0 if b else None, ib)
+                                for ib in in_batched)
+                out = jax.vmap(_scan_chunk, in_axes=in_axes)(
+                    op, c, q, l, u, omega, eta, x, y, xs, ys)
+            return out, (True, True, True, True)
+    else:
+        chunk_fn = _scan_chunk
+
     def _context(op, c, q, l, u, dr, dc):
         """Scaled problem data shared by init/chunk/finalize."""
         dtype = opts.dtype
@@ -470,10 +524,8 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int, axis=None):
                 & (s.total < limit)
 
         def body(s: _State):
-            (x, y, x_sum, y_sum), _ = jax.lax.scan(
-                functools.partial(one_iter, op=op, c=c_s, q=q_s, l=l_s, u=u_s,
-                                  eq_mask=eq_mask, omega=s.omega, eta=eta),
-                (s.x, s.y, s.x_sum, s.y_sum), None, length=opts.check_every)
+            x, y, x_sum, y_sum = chunk_fn(op, c_s, q_s, l_s, u_s, s.omega,
+                                          eta, s.x, s.y, s.x_sum, s.y_sum)
             inner = s.inner + opts.check_every
             total = s.total + opts.check_every
             x_avg = x_sum / inner.astype(x.dtype)
@@ -619,6 +671,10 @@ class CompiledLPSolver:
         _, norms = jax.lax.scan(piter, v, None, length=self.opts.power_iters)
         sigma_max = float(jnp.sqrt(norms[-1]))
         self.eta = jnp.asarray(self.opts.step_size_safety / max(sigma_max, 1e-12), dtype)
+        self._make_jits()
+
+    def _make_jits(self) -> None:
+        lp = self.lp
         self._solve = _make_solver(self.opts, lp.m, lp.n, lp.n_eq)
         data_axes = (None, 0, 0, 0, 0, None, None)
         self._jit_init = jax.jit(self._solve.init_state)
@@ -653,6 +709,29 @@ class CompiledLPSolver:
         return self._drive(c, q, l, u, batched=True)
 
     def _drive(self, c, q, l, u, batched: bool) -> PDHGResult:
+        """Fallback wrapper: if the fused Pallas chunk cannot compile on
+        this backend (scoped-VMEM limit when the libtpu flag did not make
+        it in before backend init), disable it process-wide and retry on
+        the XLA scan path."""
+        try:
+            return self._drive_inner(c, q, l, u, batched)
+        except Exception as e:
+            msg = str(e).lower()
+            if not (self.opts.pallas_chunk and batched
+                    and ("vmem" in msg or "mosaic" in msg)):
+                raise
+            from . import pallas_chunk
+            pallas_chunk.RUNTIME_DISABLED = True
+            from ..utils.errors import TellUser
+            TellUser.warning(
+                "fused Pallas chunk kernel unavailable on this backend "
+                f"({str(e).splitlines()[0][:120]}); falling back to the "
+                "XLA scan path")
+            self.opts = dataclasses.replace(self.opts, pallas_chunk=False)
+            self._make_jits()
+            return self._drive_inner(c, q, l, u, batched)
+
+    def _drive_inner(self, c, q, l, u, batched: bool) -> PDHGResult:
         """Host-chunked driver: bounded device calls until every instance
         converges, certifies infeasibility, or hits max_iters.  Keeps a
         single XLA program short (runtime watchdogs kill multi-minute
