@@ -38,9 +38,16 @@ class DERVET:
                 TellUser.warning(f"errors_log_path {log_dir!r} does not "
                                  "look like a path — no error log written")
             else:
+                # reference inputs carry Windows-style relative paths
+                # ('.\\Results\\x\\'); normalize the separators so the
+                # directory lands under ./Results, not a literal
+                # backslash-named dir
+                from pathlib import PureWindowsPath
+                parts = [p for p in PureWindowsPath(log_dir).parts
+                         if p not in (".", "\\", "/")]
+                target = Path(*parts) if parts else Path(log_dir)
                 try:
-                    TellUser.attach_file(Path(log_dir),
-                                         name="errors_log.log")
+                    TellUser.attach_file(target, name="errors_log.log")
                 except OSError as e:
                     TellUser.warning(f"could not open errors_log_path "
                                      f"{log_dir!r}: {e}")
