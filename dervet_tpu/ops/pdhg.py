@@ -258,6 +258,17 @@ class PDHGOptions:
     # state when supported (TPU backend, dense op small enough to keep K
     # in VMEM); transparent fallback to the XLA scan path otherwise
     pallas_chunk: bool = True
+    # batched driver only: once a SMALL MINORITY of instances is still
+    # unconverged past this many iterations, solve them exactly on the
+    # CPU instead of burning the remaining device budget — pathological
+    # instances (near-degenerate Monte-Carlo draws, extreme sizing-sweep
+    # candidates) can need 50-100x the median iteration count.  The
+    # division-of-labor principle at runtime: the batch rides the TPU,
+    # outliers ride HiGHS.  None disables.
+    cpu_rescue_after: Optional[int] = 65536
+    # never CPU-rescue more instances than this: a broadly-unconverged
+    # batch signals a systemic tolerance/budget problem, not outliers
+    cpu_rescue_max: int = 64
     # iterations per device call: the host loops chunks until convergence.
     # Bounding each XLA program keeps single long solves from hitting
     # runtime watchdogs (a 100k-iteration year-long LP is minutes of
@@ -771,6 +782,7 @@ class CompiledLPSolver:
         cur_state = state
         full_state = state
         total = 0
+        rescue_after = self.opts.cpu_rescue_after
         while True:
             limit = np.int32(min(total + self.opts.compact_chunk_iters,
                                  max_iters))
@@ -781,6 +793,16 @@ class CompiledLPSolver:
                                 cur_state.infeasible)))
             if n_active == 0 or total >= max_iters:
                 break
+            if rescue_after is not None and total >= rescue_after:
+                # n_active counts bucket rows, which DUPLICATE stragglers
+                # after compaction padding — the rescue threshold needs
+                # the number of distinct unconverged instances
+                act = ~(np.asarray(cur_state.converged)
+                        | np.asarray(cur_state.infeasible))
+                n_distinct = np.unique(idx[act]).size
+                if n_distinct <= min(self.opts.cpu_rescue_max,
+                                     max(1, B // 8)):
+                    break     # hand the straggler minority to the CPU
             bucket = max(8, 1 << (max(n_active - 1, 0).bit_length()))
             if bucket <= len(idx) // 2:
                 act = ~(np.asarray(cur_state.converged)
@@ -792,7 +814,50 @@ class CompiledLPSolver:
                 cur = tuple(a[pad] for a in cur)
                 cur_state = jax.tree.map(lambda a: a[pad], cur_state)
         full_state = _scatter_state(full_state, cur_state, idx)
+        full_state = self._cpu_rescue(full_state, c, q, l, u, total)
         return fin(*args, full_state)
+
+    def _cpu_rescue(self, state: "_State", c, q, l, u,
+                    total: int) -> "_State":
+        """Solve still-unconverged batch instances exactly on the CPU and
+        mark them converged with the exact primal (dual left at the last
+        iterate; downstream consumes x/obj/status only)."""
+        if (self.opts.cpu_rescue_after is None
+                or total < self.opts.cpu_rescue_after):
+            # an exit below the threshold is a deliberate iteration-budget
+            # cap — keep the documented iteration-limit/inaccurate
+            # semantics rather than silently CPU-solving
+            return state
+        act = ~(np.asarray(state.converged) | np.asarray(state.infeasible))
+        sel = np.nonzero(act)[0]
+        if sel.size == 0 or sel.size > min(self.opts.cpu_rescue_max,
+                                           max(1, state.x.shape[0] // 8)):
+            return state
+        from . import cpu_ref
+        ch, qh, lh, uh = (np.asarray(a) for a in (c, q, l, u))
+        dc = np.asarray(self.dc, np.float64)
+        ok_idx, xs = [], []
+        for i in sel:
+            r = cpu_ref.solve_lp_cpu(self.lp, c=ch[i], q=qh[i],
+                                     l=lh[i], u=uh[i])
+            if r.status != 0 or not np.isfinite(r.obj):
+                continue          # leave as-is: iteration-limit status
+            ok_idx.append(int(i))
+            xs.append(r.x / dc)   # back to the solver's scaled space
+        if not ok_idx:
+            return state
+        from ..utils.errors import TellUser
+        TellUser.info(f"{len(ok_idx)} straggler instance(s) rescued on "
+                      "the exact CPU solver")
+        ii = jnp.asarray(ok_idx)
+        X = jnp.asarray(np.stack(xs), state.x.dtype)
+        return state._replace(
+            x=state.x.at[ii].set(X),
+            done_x=state.done_x.at[ii].set(X),
+            done_y=state.done_y.at[ii].set(state.y[ii]),
+            converged=state.converged.at[ii].set(True),
+            iters_at_conv=state.iters_at_conv.at[ii].set(state.total[ii]),
+        )
 
     def batch_data(self, B: int, c, q, l, u):
         """Broadcast any shared 1-D arrays up to the batch dimension."""

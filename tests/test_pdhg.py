@@ -285,3 +285,55 @@ def test_pallas_chunk_matches_scan_on_tpu():
         for r in (res_p, res_s):
             rel = abs(float(np.asarray(r.obj)[i]) - ref) / max(1.0, abs(ref))
             assert rel < 1e-3, (i, rel)
+
+
+class TestCpuStragglerRescue:
+    """Batched driver hands a small unconverged minority to the exact CPU
+    solver once past cpu_rescue_after iterations (division of labor at
+    runtime: the batch rides the accelerator, pathological outliers ride
+    HiGHS)."""
+
+    def test_minority_rescued_with_exact_objective(self):
+        lp = battery_like_lp(T=96)
+        B = 16
+        # 15 ordinary instances (converge in ~1.5k iterations) + 1
+        # degenerate zero-cost instance, which the first-order method
+        # never terminates on (measured: >100k iterations) — the
+        # archetypal straggler
+        C = np.tile(lp.c, (B, 1))
+        C[0] = 0.0
+        opts = PDHGOptions(max_iters=8192, compact_chunk_iters=512,
+                           cpu_rescue_after=2048, pallas_chunk=False)
+        res = CompiledLPSolver(lp, opts).solve(c=C)
+        conv = np.asarray(res.converged)
+        assert bool(conv.all()), conv
+        # the rescued instance carries the exact CPU answer (obj 0 for a
+        # zero-cost LP), not a truncated first-order iterate
+        got = float(np.asarray(res.obj)[0])
+        assert abs(got) < 1e-9, got
+        assert int(np.asarray(res.status)[0]) == 0
+        # the rescue must fire shortly past the threshold — if the early
+        # break is broken the device burns the whole max_iters budget
+        # before the post-loop fallback saves the result
+        it0 = int(np.asarray(res.iters)[0])
+        assert it0 <= 2048 + 512, it0
+        # and a feasible primal: SOE dynamics hold
+        x = np.asarray(res.x)[0]
+        ene, ch, dis = (lp.value(x, k) for k in ("ene", "ch", "dis"))
+        soe = 500.0
+        for t in range(96):
+            soe = soe + 0.85 * ch[t] - dis[t]
+            assert abs(ene[t] - soe) < 1e-6
+
+    def test_majority_not_rescued(self):
+        """A broadly-unconverged batch is a systemic budget problem, not
+        outliers — it must NOT be silently CPU-solved."""
+        lp = battery_like_lp(T=96)
+        B = 8
+        rng = np.random.default_rng(2)
+        C = np.stack([lp.c * rng.uniform(0.9, 1.1, lp.n) for _ in range(B)])
+        opts = PDHGOptions(max_iters=256, compact_chunk_iters=128,
+                           cpu_rescue_after=128, pallas_chunk=False)
+        res = CompiledLPSolver(lp, opts).solve(c=C)
+        # none converge in 256 iterations and none may be rescued
+        assert not bool(np.asarray(res.converged).any())
