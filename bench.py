@@ -133,7 +133,18 @@ def main() -> None:
             log(f"bench: seed {seed} run excluded from timing — only "
                 f"{r_conv}/{r_total} converged")
         del results         # free both runs' solution buffers in HBM
-    elapsed = min(samples) if samples else dt_run
+    if not samples:
+        # no fully-converged sample: a numerics regression must fail the
+        # scripted run, not masquerade as a (fast) perf number
+        log(f"bench: NO fully-converged sample ({n_conv}/{n_total} "
+            "window-LPs converged) — metric invalid")
+        print(json.dumps({
+            "metric": ("microgrid_mc" if multi else "battery_pv_da")
+            + f"_year_dispatch_{n_scen}scen_s",
+            "value": round(dt_run, 3), "unit": "s", "vs_baseline": 0.0,
+        }))
+        raise SystemExit(3)
+    elapsed = min(samples)
     log(f"bench: steady-state samples {['%.2f' % s for s in samples]} "
         "(reporting min of fully-converged runs)")
     log(f"bench: steady-state {elapsed:.2f}s; {n_conv}/{n_total} window-LPs "

@@ -38,19 +38,31 @@ class DERVET:
                 TellUser.warning(f"errors_log_path {log_dir!r} does not "
                                  "look like a path — no error log written")
             else:
-                # reference inputs carry Windows-style relative paths
-                # ('.\\Results\\x\\'); normalize the separators so the
-                # directory lands under ./Results, not a literal
-                # backslash-named dir
+                import re
                 from pathlib import PureWindowsPath
-                parts = [p for p in PureWindowsPath(log_dir).parts
-                         if p not in (".", "\\", "/")]
-                target = Path(*parts) if parts else Path(log_dir)
-                try:
-                    TellUser.attach_file(target, name="errors_log.log")
-                except OSError as e:
-                    TellUser.warning(f"could not open errors_log_path "
-                                     f"{log_dir!r}: {e}")
+                if re.match(r"^[A-Za-z]:[\\/]", log_dir):
+                    # a Windows drive path cannot be honored on POSIX —
+                    # refusing beats mkdir'ing a literal 'C:\'-named dir
+                    TellUser.warning(f"errors_log_path {log_dir!r} is a "
+                                     "Windows drive path — no error log "
+                                     "written on this platform")
+                    target = None
+                elif log_dir.startswith("/"):
+                    target = Path(log_dir)     # POSIX absolute: as given
+                else:
+                    # reference inputs carry Windows-style RELATIVE paths
+                    # ('.\\Results\\x\\'); normalize separators so the
+                    # directory lands under ./Results, not a literal
+                    # backslash-named dir
+                    parts = [p for p in PureWindowsPath(log_dir).parts
+                             if p not in (".", "\\", "/")]
+                    target = Path(*parts) if parts else Path(log_dir)
+                if target is not None:
+                    try:
+                        TellUser.attach_file(target, name="errors_log.log")
+                    except OSError as e:
+                        TellUser.warning(f"could not open errors_log_path "
+                                         f"{log_dir!r}: {e}")
         TellUser.info(f"Initialized {len(self.cases)} case(s) from "
                       f"{model_parameters_path}")
 

@@ -256,7 +256,13 @@ class LPBuilder:
         ).tocsr()
         q = np.concatenate(q_parts) if q_parts else np.zeros(0)
         # Presolve: tighten never-binding inequality rhs to each row's own
-        # activity lower bound.  Input data carries "no limit" sentinels
+        # activity lower bound.  CONTRACT: the clamp is exact for the
+        # build-time box [l, u] and for any per-instance bounds INSIDE it
+        # (tightening only shrinks row activity ranges); a caller who
+        # widens l/u beyond the build-time box at solve time while
+        # defaulting q must rebuild the LP instead — the clamped rhs
+        # could then bind where the original sentinel never would.
+        # Input data carries "no limit" sentinels
         # (the reference datasets use 999999-style placeholders; our
         # requirement fills use 1e30) that an exact simplex ignores but
         # that dominate ||q||_2 and poison the PDHG solver's RELATIVE

@@ -69,6 +69,18 @@ if "--xla_tpu_scoped_vmem_limit_kib" not in os.environ.get(
     os.environ["LIBTPU_INIT_ARGS"] = (
         os.environ.get("LIBTPU_INIT_ARGS", "")
         + " --xla_tpu_scoped_vmem_limit_kib=100000").strip()
+    # if a backend already exists, the env append came TOO LATE (libtpu
+    # snapshots env at plugin init) and the Pallas kernel would fail to
+    # compile; record that so supports() declines up front — the sharded
+    # multi-device driver has no runtime retry hook
+    try:
+        from jax._src import xla_bridge as _xb
+        if getattr(_xb, "_backends", None):
+            from . import pallas_chunk as _pc
+            _pc.RUNTIME_DISABLED = True
+    except Exception:
+        pass    # private API moved: keep the optimistic default; the
+        # single-device driver still has its runtime fallback
 import numpy as np
 
 from .lp import LP
