@@ -133,14 +133,15 @@ def main() -> None:
             log(f"bench: seed {seed} run excluded from timing — only "
                 f"{r_conv}/{r_total} converged")
         del results         # free both runs' solution buffers in HBM
+    name = ("microgrid_mc" if multi else "battery_pv_da") \
+        + f"_year_dispatch_{n_scen}scen_s"
     if not samples:
         # no fully-converged sample: a numerics regression must fail the
         # scripted run, not masquerade as a (fast) perf number
         log(f"bench: NO fully-converged sample ({n_conv}/{n_total} "
             "window-LPs converged) — metric invalid")
         print(json.dumps({
-            "metric": ("microgrid_mc" if multi else "battery_pv_da")
-            + f"_year_dispatch_{n_scen}scen_s",
+            "metric": name,
             "value": round(dt_run, 3), "unit": "s", "vs_baseline": 0.0,
         }))
         raise SystemExit(3)
@@ -152,8 +153,6 @@ def main() -> None:
 
     # scale the target linearly if running fewer scenarios than the baseline
     baseline = BASELINE_SECONDS * n_scen / BASELINE_SCENARIOS
-    name = ("microgrid_mc" if multi else "battery_pv_da") \
-        + f"_year_dispatch_{n_scen}scen_s"
     print(json.dumps({
         "metric": name,
         "value": round(elapsed, 3),

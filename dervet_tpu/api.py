@@ -40,12 +40,14 @@ class DERVET:
             else:
                 import re
                 from pathlib import PureWindowsPath
-                if re.match(r"^[A-Za-z]:[\\/]", log_dir):
-                    # a Windows drive path cannot be honored on POSIX —
-                    # refusing beats mkdir'ing a literal 'C:\'-named dir
+                if re.match(r"^[A-Za-z]:", log_dir) or \
+                        log_dir.startswith("\\\\"):
+                    # a Windows drive (absolute OR drive-relative) or UNC
+                    # path cannot be honored on POSIX — refusing beats
+                    # mkdir'ing a literal 'C:'/'\\\\server'-named dir
                     TellUser.warning(f"errors_log_path {log_dir!r} is a "
-                                     "Windows drive path — no error log "
-                                     "written on this platform")
+                                     "Windows drive/UNC path — no error "
+                                     "log written on this platform")
                     target = None
                 elif log_dir.startswith("/"):
                     target = Path(log_dir)     # POSIX absolute: as given
