@@ -337,3 +337,41 @@ class TestCpuStragglerRescue:
         res = CompiledLPSolver(lp, opts).solve(c=C)
         # none converge in 256 iterations and none may be rescued
         assert not bool(np.asarray(res.converged).any())
+
+
+def test_pallas_disabled_when_backend_precedes_import():
+    """If user code initializes the JAX backend BEFORE dervet_tpu can
+    inject the scoped-VMEM libtpu flag, the Pallas kernel must be
+    declined up front (the sharded multi-device driver has no runtime
+    retry hook).  Run in a subprocess to control import order."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "jax.devices()\n"                       # backend initializes HERE
+        "from jax._src import xla_bridge\n"
+        "if not hasattr(xla_bridge, '_backends'):\n"
+        # the production gate is best-effort over this private attr and
+        # deliberately degrades to the optimistic default if it moves —
+        # then there is nothing to assert here
+        "    print('gate unavailable'); raise SystemExit(0)\n"
+        "from dervet_tpu.ops import pallas_chunk, pdhg  # noqa: F401\n"
+        "assert pallas_chunk.RUNTIME_DISABLED, 'gate missed'\n"
+        "print('gate ok')\n"
+    )
+    # the parent test process already injected the scoped-VMEM flag into
+    # LIBTPU_INIT_ARGS (inherited env would make the gate correctly a
+    # no-op); simulate a user process where the flag never made it in
+    env = {k: v for k, v in os.environ.items() if k != "LIBTPU_INIT_ARGS"}
+    out = subprocess.run([sys.executable, "-c", code],
+                         cwd=str(Path(__file__).resolve().parents[1]),
+                         capture_output=True, text=True, timeout=300,
+                         env=env)
+    assert out.returncode == 0, out.stderr[-800:]
+    if "gate unavailable" in out.stdout:
+        pytest.skip("jax private backend registry moved; gate is soft")
+    assert "gate ok" in out.stdout
