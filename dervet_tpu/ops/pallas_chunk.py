@@ -37,7 +37,21 @@ from jax.experimental.pallas import tpu as pltpu
 # K must stay VMEM-resident next to the instance block; above this size
 # fall back to the XLA scan path (v5e VMEM is ~16 MB/core)
 MAX_K_BYTES = 10 * 1024 * 1024
-BLK = 128         # instances per grid step: full MXU tile rows
+# instances per grid step: must fill the 128-wide MXU (32 loses to the
+# XLA scan path, PERF.md); 256 = two tile rows measured ~3% faster than
+# 128 at large batches, but small batches would waste up to half the
+# block on padding — picked per batch below
+BLK_MAX = 256
+
+
+def _pick_blk(B: int) -> int:
+    return 128 if B <= 128 else BLK_MAX
+
+
+def _block_vmem_bytes(m: int, n: int, blk: int) -> int:
+    """Scoped-VMEM footprint of one grid step: K + the blocked operands
+    (7 x-space blocks incl. outputs, 5 y-space) that co-reside with it."""
+    return m * n * 4 + blk * (7 * n + 5 * m) * 4
 
 
 def _chunk_kernel(iters: int,
@@ -77,10 +91,10 @@ def _chunk_kernel(iters: int,
 
 
 @functools.lru_cache(maxsize=32)
-def _build_call(m: int, n: int, iters: int, grid: int):
-    blk_x = pl.BlockSpec((BLK, n), lambda i: (i, 0))
-    blk_y = pl.BlockSpec((BLK, m), lambda i: (i, 0))
-    blk_s = pl.BlockSpec((BLK, 1), lambda i: (i, 0))
+def _build_call(m: int, n: int, iters: int, grid: int, blk: int):
+    blk_x = pl.BlockSpec((blk, n), lambda i: (i, 0))
+    blk_y = pl.BlockSpec((blk, m), lambda i: (i, 0))
+    blk_s = pl.BlockSpec((blk, 1), lambda i: (i, 0))
     shared_k = pl.BlockSpec((m, n), lambda i: (0, 0))
     shared_f = pl.BlockSpec((1, m), lambda i: (0, 0))
     return pl.pallas_call(
@@ -95,10 +109,10 @@ def _build_call(m: int, n: int, iters: int, grid: int):
                   blk_x, blk_y, blk_x, blk_y, shared_k, shared_f],
         out_specs=[blk_x, blk_y, blk_x, blk_y],
         out_shape=[
-            jax.ShapeDtypeStruct((grid * BLK, n), jnp.float32),
-            jax.ShapeDtypeStruct((grid * BLK, m), jnp.float32),
-            jax.ShapeDtypeStruct((grid * BLK, n), jnp.float32),
-            jax.ShapeDtypeStruct((grid * BLK, m), jnp.float32),
+            jax.ShapeDtypeStruct((grid * blk, n), jnp.float32),
+            jax.ShapeDtypeStruct((grid * blk, m), jnp.float32),
+            jax.ShapeDtypeStruct((grid * blk, n), jnp.float32),
+            jax.ShapeDtypeStruct((grid * blk, m), jnp.float32),
         ],
     )
 
@@ -126,7 +140,12 @@ def supports(op, dtype, precision=None, backend: Optional[str] = None) -> bool:
     if not isinstance(op, DenseOp):
         return False
     mm, nn = op.Kh.shape
-    return mm * nn * 4 <= MAX_K_BYTES
+    if mm * nn * 4 > MAX_K_BYTES:
+        return False
+    # the blocked operands co-reside with K in scoped VMEM; a skewed
+    # shape (huge n, tiny m) can blow the budget even with a small K —
+    # decline it and let the scan path handle it
+    return _block_vmem_bytes(mm, nn, BLK_MAX) <= 90 * 1024 * 1024
 
 
 def batched_chunk(op, c, q, l, u, omega, eta, x, y, xs, ys,
@@ -135,8 +154,9 @@ def batched_chunk(op, c, q, l, u, omega, eta, x, y, xs, ys,
     kernel.  All data args are (B, ·); omega is (B,)."""
     B = x.shape[0]
     m, n = op.Kh.shape
-    grid = -(-B // BLK)
-    pad = grid * BLK - B
+    blk = _pick_blk(B)
+    grid = -(-B // blk)
+    pad = grid * blk - B
 
     def p(a):
         return jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1)) if pad else a
@@ -145,7 +165,7 @@ def batched_chunk(op, c, q, l, u, omega, eta, x, y, xs, ys,
     sig = (eta * omega)[:, None].astype(jnp.float32)
     floor = jnp.where(jnp.arange(m) < n_eq, -jnp.inf, 0.0)[None, :] \
         .astype(jnp.float32)
-    call = _build_call(m, n, iters, grid)
+    call = _build_call(m, n, iters, grid, blk)
     xo, yo, xso, yso = call(p(c), p(q), p(l), p(u), p(tau), p(sig),
                             p(x), p(y), p(xs), p(ys), op.Kh, floor)
     if pad:
