@@ -118,6 +118,7 @@ def main() -> None:
     # numerics regression, not a speedup.
     samples = []
     n_total = n_conv = max_it = 0
+    iters_all = []
     for seed in (31, 43):
         t0 = time.time()
         results = run_all(seed=seed)
@@ -126,6 +127,8 @@ def main() -> None:
         r_conv = sum(int(np.asarray(r.converged).sum()) for r in results)
         max_it = max(max_it,
                      max(int(np.asarray(r.iters).max()) for r in results))
+        iters_all.append(np.concatenate(
+            [np.asarray(r.iters).ravel() for r in results]))
         n_total, n_conv = n_total + r_total, n_conv + r_conv
         if r_conv == r_total:
             samples.append(dt_run)
@@ -151,6 +154,40 @@ def main() -> None:
     log(f"bench: steady-state {elapsed:.2f}s; {n_conv}/{n_total} window-LPs "
         f"converged across samples, worst iters {max_it}")
 
+    # self-describing solve path (VERDICT r3 #1/#10): which kernel path
+    # actually ran, on what, with what iteration profile — so a perf
+    # regression is attributable without log archaeology
+    from dervet_tpu.ops import pallas_chunk
+
+    group_cfg = []
+    for T, solver, c_stack, Q, L, U in jobs:
+        group_cfg.append({
+            "T": T, "batch": int(Q.shape[0]),
+            "n": solver.lp.n, "m": solver.lp.m,
+            "pallas": bool(solver.opts.pallas_chunk
+                           and pallas_chunk.supports(
+                               solver.op, solver.opts.dtype,
+                               solver.opts.precision)),
+        })
+    pallas_used = (not pallas_chunk.RUNTIME_DISABLED
+                   and all(g["pallas"] for g in group_cfg))
+    it = np.concatenate(iters_all)
+    config = {
+        "backend": jax.default_backend(),
+        "device_kind": dev.device_kind,
+        "pallas_blk": pallas_chunk.BLK,
+        "compact_chunk_iters": jobs[0][1].opts.compact_chunk_iters,
+        "groups": group_cfg,
+        "iters": {"p50": int(np.percentile(it, 50)),
+                  "p90": int(np.percentile(it, 90)),
+                  "p99": int(np.percentile(it, 99)),
+                  "max": int(it.max())},
+    }
+    log(f"bench: pallas={'on' if pallas_used else 'OFF (scan path)'} "
+        f"iters p50/p90/p99/max {config['iters']['p50']}/"
+        f"{config['iters']['p90']}/{config['iters']['p99']}/"
+        f"{config['iters']['max']}")
+
     # scale the target linearly if running fewer scenarios than the baseline
     baseline = BASELINE_SECONDS * n_scen / BASELINE_SCENARIOS
     print(json.dumps({
@@ -158,6 +195,8 @@ def main() -> None:
         "value": round(elapsed, 3),
         "unit": "s",
         "vs_baseline": round(baseline / elapsed, 3),
+        "pallas": pallas_used,
+        "config": config,
     }))
 
     if int(os.environ.get("BENCH_REAL_CASE", "0")):

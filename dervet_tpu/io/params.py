@@ -413,6 +413,41 @@ class Params:
         return case_defs, pd.DataFrame(records)
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def bad_active_combo(ders, streams) -> None:
+        """Params-time prediction that a combination of active tags cannot
+        produce a solvable run — erroring HERE, before any optimization
+        window is assembled, instead of surfacing later as an opaque
+        solver/assembly failure (reference: ParamsDER.bad_active_combo,
+        dervet/DERVETParams.py:143-155, delegating to the storagevet
+        parent with ``dervet=True, other_ders=...``)."""
+        der_tags = {t for t, _, _ in ders}
+        active_streams = set(streams)
+        if not der_tags:
+            raise ModelParameterError(
+                "no DER technology is active — activate at least one "
+                "technology tag (Battery, PV, ICE, …) or there is nothing "
+                "to dispatch")
+        if not active_streams:
+            raise ModelParameterError(
+                "no value stream is active — activate at least one service "
+                "tag (DA, retailTimeShift, Reliability, …) or there is "
+                "nothing to optimize for")
+        if {"RA", "DR"} <= active_streams:
+            raise ModelParameterError(
+                "Resource Adequacy and Demand Response cannot both be "
+                "active: their dispatch-constraint days conflict")
+        markets = active_streams & {"FR", "SR", "NSR", "LF"}
+        dispatchable = der_tags & {"Battery", "CAES", "ICE", "DieselGenset",
+                                   "CT", "CHP"}
+        if markets and not dispatchable:
+            raise ModelParameterError(
+                f"market service(s) {sorted(markets)} require a "
+                "dispatchable technology (storage or generator); active "
+                f"technologies {sorted(der_tags)} cannot hold reserve "
+                "capacity")
+
+    # ------------------------------------------------------------------
     @classmethod
     def _build_case(cls, case_id, rows, overrides, base, verbose) -> CaseParams:
         overrides = dict(overrides)
@@ -493,6 +528,7 @@ class Params:
         if rel.get("load_shed_percentage") and rel.get("load_shed_perc_filename"):
             datasets.load_shed = pd.read_csv(
                 normalize_path(rel["load_shed_perc_filename"], base))
+        cls.bad_active_combo(ders, streams)
         return CaseParams(case_id=case_id, scenario=scenario, finance=finance,
                           results=results, ders=ders, streams=streams,
                           datasets=datasets, overrides=dict(overrides),

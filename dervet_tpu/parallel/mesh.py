@@ -63,7 +63,32 @@ def solve_batch_sharded(solver: CompiledLPSolver, mesh: Mesh,
 
     Returns ``(PDHGResult, ShardedStats)`` with result arrays batched on the
     original (un-padded) leading axis.
+
+    Like ``CompiledLPSolver._drive``, falls back to the XLA scan path if
+    the fused Pallas chunk kernel fails to COMPILE on this backend (the
+    vmapped stages fire the same custom-vmap rule inside ``shard_map``).
     """
+    import dataclasses
+
+    from ..ops.pdhg import disable_pallas_runtime, is_pallas_compile_failure
+    try:
+        return _solve_batch_sharded_inner(solver, mesh, c, q, l, u)
+    except Exception as e:
+        from ..ops import pallas_chunk
+        kernel_in_play = (solver.opts.pallas_chunk
+                          and pallas_chunk.supports(
+                              solver.op, solver.opts.dtype,
+                              solver.opts.precision))
+        if not (kernel_in_play and is_pallas_compile_failure(e)):
+            raise
+        disable_pallas_runtime(e)
+        solver.opts = dataclasses.replace(solver.opts, pallas_chunk=False)
+        solver._make_jits()
+        return _solve_batch_sharded_inner(solver, mesh, c, q, l, u)
+
+
+def _solve_batch_sharded_inner(solver: CompiledLPSolver, mesh: Mesh,
+                               c=None, q=None, l=None, u=None):
     c, q, l, u = solver._data(c, q, l, u)
     sizes = {arr.shape[0] for arr in (c, q, l, u) if arr.ndim == 2}
     if not sizes:
@@ -115,9 +140,11 @@ def solve_batch_sharded(solver: CompiledLPSolver, mesh: Mesh,
                            prim_res=P(AXIS), gap=P(AXIS), status=P(AXIS))
     sh_init = jax.jit(jax.shard_map(
         local_init, mesh=mesh, in_specs=(P(AXIS),) * 4, out_specs=P(AXIS)))
+    from ..ops.pdhg import pallas_compiler_options
     sh_chunk = jax.jit(jax.shard_map(
         local_chunk, mesh=mesh,
-        in_specs=(P(AXIS),) * 4 + (P(AXIS), P()), out_specs=P(AXIS)))
+        in_specs=(P(AXIS),) * 4 + (P(AXIS), P()), out_specs=P(AXIS)),
+        compiler_options=pallas_compiler_options(solver.opts))
     sh_fin = jax.jit(jax.shard_map(
         local_fin, mesh=mesh, in_specs=(P(AXIS),) * 4 + (P(AXIS), P(AXIS)),
         out_specs=(res_specs, ShardedStats(n_converged=P(), max_iters=P(),

@@ -1,6 +1,6 @@
 """CLI entry point (mirrors reference run_DERVET.py:73-92).
 
-Usage:  python run_dervet_tpu.py <model_parameters.csv> [-v] [--backend jax|cpu]
+Usage:  python run_dervet_tpu.py <model_parameters.csv> [-v] [--backend auto|jax|cpu]
                                  [--base-path DIR] [--out DIR]
 """
 import argparse
@@ -20,9 +20,12 @@ def main(argv=None):
     parser.add_argument("parameters_filename",
                         help="model parameters CSV/JSON file")
     parser.add_argument("-v", "--verbose", action="store_true")
-    parser.add_argument("--backend", default="jax", choices=["jax", "cpu"],
-                        help="dispatch solver backend (jax = batched PDHG on "
-                             "TPU; cpu = scipy HiGHS cross-validation path)")
+    parser.add_argument("--backend", default="auto",
+                        choices=["auto", "jax", "cpu"],
+                        help="dispatch solver backend (auto = jax for large "
+                             "dispatches, cpu below the compile-amortization "
+                             "threshold; jax = batched PDHG on TPU; cpu = "
+                             "scipy HiGHS cross-validation path)")
     parser.add_argument("--base-path", default=None,
                         help="root for relative referenced-data paths "
                              "(default: the parameters file's directory)")

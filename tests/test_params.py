@@ -164,3 +164,52 @@ def test_allowed_values_accepted(tmp_path):
     path = _template_with(tmp_path, "Battery", "salvage_value", "5000")
     cases = Params.initialize(path, base_path=REF)
     assert len(cases) == 1
+
+
+# ---------------------------------------------------------------------------
+# bad_active_combo (VERDICT r3 #8): Params-time rejection of active-tag
+# combinations that cannot produce a solvable run, before any window is
+# assembled (reference: dervet/DERVETParams.py:143-155).
+# ---------------------------------------------------------------------------
+
+def _template_with_active(tmp_path, activate=(), deactivate=()):
+    import pandas as pd
+    df = pd.read_csv(REF / "Model_Parameters_Template_DER.csv")
+    for tag in activate:
+        sel = df.Tag == tag
+        assert sel.any(), tag
+        df.loc[sel, "Active"] = "yes"
+    for tag in deactivate:
+        df.loc[df.Tag == tag, "Active"] = "no"
+    out = tmp_path / "mp.csv"
+    df.to_csv(out, index=False)
+    return out
+
+
+class TestBadActiveCombo:
+    # template baseline active tags: Battery + DA (+ Scenario/Finance)
+
+    def test_no_der_active(self, tmp_path):
+        path = _template_with_active(tmp_path, deactivate=("Battery",))
+        with pytest.raises(ModelParameterError, match="technology"):
+            Params.initialize(path, base_path=REF)
+
+    def test_no_stream_active(self, tmp_path):
+        path = _template_with_active(tmp_path, deactivate=("DA",))
+        with pytest.raises(ModelParameterError, match="value stream"):
+            Params.initialize(path, base_path=REF)
+
+    def test_ra_and_dr_conflict(self, tmp_path):
+        path = _template_with_active(tmp_path, activate=("RA", "DR"))
+        with pytest.raises(ModelParameterError, match="Resource Adequacy"):
+            Params.initialize(path, base_path=REF)
+
+    def test_market_without_dispatchable_der(self, tmp_path):
+        path = _template_with_active(tmp_path, activate=("FR", "PV"),
+                                     deactivate=("Battery",))
+        with pytest.raises(ModelParameterError, match="dispatchable"):
+            Params.initialize(path, base_path=REF)
+
+    def test_good_combo_untouched(self, tmp_path):
+        path = _template_with_active(tmp_path)     # Battery + DA baseline
+        assert len(Params.initialize(path, base_path=REF)) == 1

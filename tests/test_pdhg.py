@@ -277,7 +277,7 @@ def test_pallas_chunk_matches_scan_on_tpu():
                                  solver_p.opts.precision)
     res_p = solver_p.solve(c=C)
     assert not pallas_chunk.RUNTIME_DISABLED, \
-        "kernel fell back at runtime — scoped-VMEM flag missing?"
+        "kernel fell back at runtime — compile failed on this backend?"
     res_s = CompiledLPSolver(lp, PDHGOptions(pallas_chunk=False)).solve(c=C)
     assert bool(np.asarray(res_p.converged).all())
     for i in (0, 64, 129):
@@ -339,39 +339,49 @@ class TestCpuStragglerRescue:
         assert not bool(np.asarray(res.converged).any())
 
 
-def test_pallas_disabled_when_backend_precedes_import():
-    """If user code initializes the JAX backend BEFORE dervet_tpu can
-    inject the scoped-VMEM libtpu flag, the Pallas kernel must be
-    declined up front (the sharded multi-device driver has no runtime
-    retry hook).  Run in a subprocess to control import order."""
-    import os
-    import subprocess
-    import sys
-    from pathlib import Path
+def test_widened_bounds_with_default_q_rejected():
+    """The presolve rhs clamp's contract (ADVICE r3): per-instance l/u
+    passed to solve() with a defaulted q must stay INSIDE the build-time
+    box — widening it could make a clamped 'ge' row bind incorrectly with
+    no diagnostic.  Tighter bounds and explicit-q calls stay allowed."""
+    from dervet_tpu.ops.pdhg import CompiledLPSolver, PDHGOptions
 
-    code = (
-        "import jax\n"
-        "jax.config.update('jax_platforms', 'cpu')\n"
-        "jax.devices()\n"                       # backend initializes HERE
-        "from jax._src import xla_bridge\n"
-        "if not getattr(xla_bridge, '_backends', None):\n"
-        # the production gate is best-effort over this private attr and
-        # deliberately degrades to the optimistic default if it moves —
-        # then there is nothing to assert here
-        "    print('gate unavailable'); raise SystemExit(0)\n"
-        "from dervet_tpu.ops import pallas_chunk, pdhg  # noqa: F401\n"
-        "assert pallas_chunk.RUNTIME_DISABLED, 'gate missed'\n"
-        "print('gate ok')\n"
-    )
-    # the parent test process already injected the scoped-VMEM flag into
-    # LIBTPU_INIT_ARGS (inherited env would make the gate correctly a
-    # no-op); simulate a user process where the flag never made it in
-    env = {k: v for k, v in os.environ.items() if k != "LIBTPU_INIT_ARGS"}
-    out = subprocess.run([sys.executable, "-c", code],
-                         cwd=str(Path(__file__).resolve().parents[1]),
-                         capture_output=True, text=True, timeout=300,
-                         env=env)
-    assert out.returncode == 0, out.stderr[-800:]
-    if "gate unavailable" in out.stdout:
-        pytest.skip("jax private backend registry moved; gate is soft")
-    assert "gate ok" in out.stdout
+    lp = battery_like_lp(T=24)
+    solver = CompiledLPSolver(lp, PDHGOptions(max_iters=512))
+    wide_u = lp.u * 2.0
+    with pytest.raises(ValueError, match="build-time box"):
+        solver.solve(u=wide_u)
+    with pytest.raises(ValueError, match="build-time box"):
+        solver.solve(l=lp.l - 1.0, u=None)
+    # inside the box: fine (shrinking is exactly what the clamp allows)
+    solver.solve(u=lp.u * 0.5)
+    # explicit q: the clamp contract is the caller's problem, no gate
+    solver.solve(q=lp.q, u=wide_u)
+
+
+def test_pallas_compile_failure_classifier():
+    """The runtime fallback must catch exactly the kernel's COMPILE
+    failure signatures — Mosaic scoped-VMEM rejections and the
+    remote-compile helper crash — and must NOT swallow generic device
+    errors that merely mention VMEM (a runtime resource exhaustion from
+    an oversized batch has to propagate, not retry slowly on the scan
+    path)."""
+    from dervet_tpu.ops.pdhg import is_pallas_compile_failure
+
+    caught = [
+        "INTERNAL: http://127.0.0.1:8103/remote_compile: HTTP 500: "
+        "tpu_compile_helper subprocess exit code 1",
+        "Mosaic failed to compile TPU kernel: …",
+        "RESOURCE_EXHAUSTED: scoped vmem limit exceeded",
+        "requested vmem limit 104857600 exceeds device maximum",
+    ]
+    passed_through = [
+        "RESOURCE_EXHAUSTED: Out of memory allocating 2.1G in vmem/hbm",
+        "RESOURCE_EXHAUSTED: out of HBM allocating batch buffers",
+        "FAILED_PRECONDITION: device halted",
+        "some unrelated ValueError",
+    ]
+    for msg in caught:
+        assert is_pallas_compile_failure(Exception(msg)), msg
+    for msg in passed_through:
+        assert not is_pallas_compile_failure(Exception(msg)), msg

@@ -64,6 +64,33 @@ class TestAssetDepreciation:
             + rows["Federal Tax Burden"].values)
 
 
+def test_macrs_15_year_table_matches_reference(tmp_path):
+    """Deliberate parity pin (VERDICT r3 #7): the reference's 15-year
+    MACRS table carries 6.83% at year 5 (dervet/CBA.py:88) where IRS Pub
+    946 says 6.93; we follow the REFERENCE so fixed-size tax rows agree
+    by construction.  This runs macrs_term=15 end-to-end and asserts the
+    year-5 depreciation against the 6.83 value exactly — if someone
+    "fixes" the table to the IRS number, this fails loudly."""
+    import pandas as pd
+
+    from dervet_tpu.financial.cba import MACRS_TABLES
+
+    assert MACRS_TABLES[15][4] == 6.83     # reference CBA.py:88, not 6.93
+
+    df = pd.read_csv(MP / "002-tax_scenario.csv")
+    sel = (df.Tag == "Battery") & (df.Key == "macrs_term")
+    assert sel.any()
+    df.loc[sel, "Optimization Value"] = "15"
+    mp = tmp_path / "mp15.csv"
+    df.to_csv(mp, index=False)
+    inst = DERVET(mp, base_path=REF).solve(backend="cpu").instances[0]
+    dep = inst.tax_breakdown_df["BATTERY: es MACRS Depreciation"]
+    rows = dep[dep.index != "CAPEX Year"].values
+    # battery capex 825k (002 fixture): year-5 depreciation at 6.83%
+    assert rows[4] == pytest.approx(-825000 * 0.0683)
+    assert rows[0] == pytest.approx(-825000 * 0.05)
+
+
 def test_linear_salvage_value_runs():
     """006-linear_salvage_value runs end-to-end (its battery life exactly
     spans the analysis window and salvage_value=0, so no salvage lands —
