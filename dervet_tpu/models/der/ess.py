@@ -271,24 +271,34 @@ class EnergyStorage(DER):
                              label=f"{self.name} fixed_om")
 
     def set_size(self, sizes: Dict[str, float]) -> None:
-        """Freeze solved size variables into ratings (reference:
-        ESSSizing.set_size, applied after the first window —
-        MicrogridScenario.py:361-363)."""
+        """Freeze solved size variables into ratings, snapped to the
+        reference's integer grid (reference: ESSSizing.set_size with
+        ``integer=True`` size vars, applied after the first window —
+        MicrogridScenario.py:361-363, ESSSizing.py:83-138)."""
+        from .base import integer_size
+
+        self.size_continuous = {k: float(v) for k, v in sizes.items()}
         if "size_ene" in sizes:
-            self.ene_max_rated = float(sizes["size_ene"])
+            self.ene_max_rated = integer_size(float(sizes["size_ene"]),
+                                              self.user_bounds["ene"][1])
             self.sizing_ene = False
         if "size_ch" in sizes:
-            self.ch_max_rated = float(sizes["size_ch"])
+            self.ch_max_rated = integer_size(float(sizes["size_ch"]),
+                                             self.user_bounds["ch"][1])
             self.sizing_ch = False
         if "size_dis" in sizes:
-            self.dis_max_rated = float(sizes["size_dis"])
+            self.dis_max_rated = integer_size(float(sizes["size_dis"]),
+                                              self.user_bounds["dis"][1])
             if self.sizing_ch:      # shared power cap (both were zero)
                 self.ch_max_rated = self.dis_max_rated
                 self.sizing_ch = False
             self.sizing_dis = False
+        cont = ", ".join(f"{k[5:]} {v:.2f}"
+                         for k, v in self.size_continuous.items())
         TellUser.info(f"{self.name} sized: {self.ene_max_rated:.1f} kWh, "
                       f"ch {self.ch_max_rated:.1f} kW / "
-                      f"dis {self.dis_max_rated:.1f} kW")
+                      f"dis {self.dis_max_rated:.1f} kW "
+                      f"(continuous relaxation: {cont})")
 
     def _soe_rows(self, ene, ch, dis, T: int, dt: float):
         """Begin-of-step SOE constraint blocks shared by the fixed-size and

@@ -64,7 +64,10 @@ class RotatingGenerator(DER):
 
     def set_size(self, sizes) -> None:
         if "size" in sizes:
-            self.rated_power = float(sizes["size"])
+            from .base import integer_size
+            self.size_continuous = {"size": float(sizes["size"])}
+            hi = float(self.keys.get("max_rated_capacity", 0) or 0.0)
+            self.rated_power = integer_size(float(sizes["size"]), hi)
             self._size_frozen = True
 
     def build(self, b: LPBuilder, ctx: WindowContext) -> None:

@@ -130,15 +130,22 @@ def _build_call(m: int, n: int, iters: int, grid: int, blk: int):
 RUNTIME_DISABLED = False
 
 
-def supports(op, dtype, precision=None, backend: Optional[str] = None) -> bool:
+def supports(op, dtype, precision=None, backend: Optional[str] = None,
+             ignore_runtime_disabled: bool = False) -> bool:
     """Static gate: dense op, f32 at HIGHEST precision, on a real TPU
     backend, K + one operand block fits the per-grid-step VMEM envelope
     (MAX_STEP_BYTES, measured on the remote-compile v5e — larger steps
     crash the compile helper, not just fail gracefully).  The kernel
     hardcodes HIGHEST matmuls (DEFAULT diverges, PERF.md), so any other
-    requested precision stays on the scan path, which honors it."""
+    requested precision stays on the scan path, which honors it.
+
+    ``ignore_runtime_disabled`` is for COMPILE-FAILURE HANDLERS deciding
+    whether the failed program could have embedded the kernel: the
+    program was traced before any concurrent thread flipped
+    RUNTIME_DISABLED, so the handler must not consult it (a second
+    thread would otherwise re-raise instead of falling back)."""
     from .pdhg import DenseOp
-    if RUNTIME_DISABLED:
+    if RUNTIME_DISABLED and not ignore_runtime_disabled:
         return False
     if precision is not None and precision != jax.lax.Precision.HIGHEST:
         return False

@@ -55,10 +55,14 @@ if not os.environ.get("DERVET_TPU_NO_XLA_CACHE"):
                          "dervet_tpu_xla"))
         os.makedirs(_cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        # 0.5 s, not the 2 s default: on a remote-compile tunnel even tiny
+        # programs cost ~0.9 s of HTTP round-trip — a 128-case sweep pays
+        # ~170 s of such compiles (profiled r4), all cacheable
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:                       # never let caching break solves
         pass
 import numpy as np
+import scipy.sparse as sp
 
 from .lp import LP
 
@@ -118,6 +122,45 @@ class EllOp(NamedTuple):
     dense_blk: jax.Array     # (m, kd)
 
 
+@jax.tree_util.register_pytree_node_class
+class BandedOp:
+    """Diagonal-band decomposition of a dispatch constraint matrix.
+
+    Dispatch LPs are time-structured: almost every nonzero K[i, j] lies on
+    one of a handful of diagonals j - i = d (SOE bidiagonals, per-step
+    coupling rows between variable blocks laid out T apart), so the gather
+    ``x[cols]`` an ELLPACK matvec needs — pathologically slow on TPU, the
+    whole 105k-step year matvec measured ~5 ms — collapses into a few
+    STATIC shifted slices of a padded vector, which XLA fuses into one
+    VPU pass (measured ~50x faster at the same shapes).
+
+      K @ x:    out[i]  = sum_b diag_b[i] * x[i + d_b]
+      K.T @ y:  out[j]  = sum_b diag_b[j - d_b] * y[j - d_b]   (same trick,
+                 shifting the product diag_b * y — no transpose table)
+
+    Entries off the selected bands (monthly aggregation rows, requirement
+    rows with irregular column patterns) ride a residual ELLPACK op, and
+    near-dense columns stay in its explicit dense block.  ``offsets`` is
+    static python metadata (pytree aux), so the slices compile to fixed
+    windows."""
+
+    def __init__(self, diags, offsets, m, n, ell=None):
+        self.diags = diags          # (nb, m) band values
+        self.offsets = offsets      # static tuple of int, j - i per band
+        self.m = m
+        self.n = n
+        self.ell = ell              # residual EllOp or None
+
+    def tree_flatten(self):
+        return (self.diags, self.ell), (self.offsets, self.m, self.n)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        diags, ell = children
+        offsets, m, n = aux
+        return cls(diags, offsets, m, n, ell)
+
+
 class ShardRowOp(NamedTuple):
     """Row(constraint)-sharded operator for ONE large LP spread over a
     device mesh axis (time-axis "sequence parallelism": dispatch-LP rows
@@ -129,7 +172,7 @@ class ShardRowOp(NamedTuple):
     eq_mask: jax.Array       # (m_local,) bool
 
 
-MatOp = Union[DenseOp, EllOp]
+MatOp = Union[DenseOp, EllOp, BandedOp]
 
 
 def _inner_op(op) -> MatOp:
@@ -160,9 +203,27 @@ def _csr_to_ell(K) -> tuple[np.ndarray, np.ndarray]:
     return data, cols
 
 
+def _build_ell(K_csr, dense_cols, blk, dtype) -> EllOp:
+    d, c = _csr_to_ell(K_csr)
+    dt, ct = _csr_to_ell(K_csr.T.tocsr())
+    return EllOp(data=jnp.asarray(d, dtype), cols=jnp.asarray(c),
+                 data_t=jnp.asarray(dt, dtype), cols_t=jnp.asarray(ct),
+                 dense_idx=jnp.asarray(dense_cols, jnp.int32),
+                 dense_blk=jnp.asarray(blk, dtype))
+
+
 def make_op(K_scaled, dense_bytes_limit: int = 32 * 1024 * 1024,
-            dtype=jnp.float32, dense_col_factor: int = 16) -> MatOp:
-    """Pick dense vs ELL for the (already Ruiz-scaled) constraint matrix."""
+            dtype=jnp.float32, dense_col_factor: int = 16,
+            max_bands: int = 48) -> MatOp:
+    """Pick dense vs banded vs ELL for the (Ruiz-scaled) constraint matrix.
+
+    Large dispatch LPs are time-structured: nearly all nonzeros lie on a
+    handful of diagonals j - i = d, which BandedOp turns into static
+    shifted slices (the ELL gather path measured ~5 ms per 105k-step year
+    matvec on TPU; the banded path ~0.1 ms).  Bands carrying at least
+    ``m / 64`` entries (up to ``max_bands``) are extracted; the leftover
+    entries — aggregation rows, irregular requirement rows — ride a
+    residual ELL op only if they exist."""
     m, n = K_scaled.shape
     if m * n * jnp.dtype(dtype).itemsize <= dense_bytes_limit:
         return DenseOp(Kh=jnp.asarray(K_scaled.todense(), dtype))
@@ -179,19 +240,57 @@ def make_op(K_scaled, dense_bytes_limit: int = 32 * 1024 * 1024,
         sparse_part.eliminate_zeros()
     else:
         blk = np.zeros((m, 0))
-        sparse_part = K_scaled
-    d, c = _csr_to_ell(sparse_part)
-    dt, ct = _csr_to_ell(sparse_part.T.tocsr())
-    return EllOp(data=jnp.asarray(d, dtype), cols=jnp.asarray(c),
-                 data_t=jnp.asarray(dt, dtype), cols_t=jnp.asarray(ct),
-                 dense_idx=jnp.asarray(dense_cols, jnp.int32),
-                 dense_blk=jnp.asarray(blk, dtype))
+        sparse_part = K_scaled.tocsr()
+
+    coo = sparse_part.tocoo()
+    offs = coo.col.astype(np.int64) - coo.row.astype(np.int64)
+    uniq, counts = np.unique(offs, return_counts=True)
+    band_min = max(256, m // 64)
+    cand = uniq[counts >= band_min]
+    if len(cand) > max_bands:       # keep the heaviest bands
+        order = np.argsort(counts[np.isin(uniq, cand)])[::-1]
+        cand = cand[order[:max_bands]]
+    on_band = np.isin(offs, cand)
+    # banded only pays off if it absorbs the bulk of the matrix
+    if len(cand) == 0 or on_band.sum() < 0.5 * max(len(offs), 1):
+        return _build_ell(sparse_part, dense_cols, blk, dtype)
+    offsets = tuple(int(v) for v in cand)
+    band_pos = {d: b for b, d in enumerate(offsets)}
+    diags = np.zeros((len(offsets), m), np.float64)
+    rows_b = coo.row[on_band]
+    diags[np.fromiter((band_pos[d] for d in offs[on_band]), np.int64,
+                      int(on_band.sum())), rows_b] = coo.data[on_band]
+    resid_nnz = int((~on_band).sum())
+    ell = None
+    if resid_nnz or len(dense_cols):
+        resid = sp.coo_matrix(
+            (coo.data[~on_band], (coo.row[~on_band], coo.col[~on_band])),
+            shape=(m, n)).tocsr()
+        ell = _build_ell(resid, dense_cols, blk, dtype)
+    return BandedOp(diags=jnp.asarray(diags, dtype), offsets=offsets,
+                    m=m, n=n, ell=ell)
 
 
 def op_matvec(op: MatOp, x: jax.Array, prec) -> jax.Array:
     """K @ x (scaled space)."""
     if isinstance(op, DenseOp):
         return jnp.matmul(op.Kh, x, precision=prec)
+    if isinstance(op, BandedOp):
+        # out[i] = sum_b diag_b[i] * x[i + d_b]: pad x so every shifted
+        # window is a static in-bounds slice, then one fused VPU pass
+        m, n = op.m, op.n
+        lo = min(op.offsets)
+        hi = max(op.offsets)
+        left = max(0, -lo)
+        right = max(0, hi + m - n)
+        xp = jnp.pad(x, (left, right))
+        out = jnp.zeros((m,), x.dtype)
+        for b, d in enumerate(op.offsets):
+            out = out + op.diags[b] * jax.lax.slice(
+                xp, (left + d,), (left + d + m,))
+        if op.ell is not None:
+            out = out + op_matvec(op.ell, x, prec)
+        return out
     out = jnp.sum(op.data * x[op.cols], axis=-1)
     if op.dense_blk.shape[1]:
         out = out + jnp.matmul(op.dense_blk, x[op.dense_idx], precision=prec)
@@ -202,6 +301,24 @@ def op_rmatvec(op: MatOp, y: jax.Array, prec) -> jax.Array:
     """K.T @ y (scaled space)."""
     if isinstance(op, DenseOp):
         return jnp.matmul(op.Kh.T, y, precision=prec)
+    if isinstance(op, BandedOp):
+        # out[j] = sum_b diag_b[j - d_b] * y[j - d_b]: shift the product
+        # band * y by +d_b — the transpose needs no table of its own.
+        # Window of V[b] for band d: [j - d for j in [0, n)] = [-d, n - d);
+        # pad so every band's window is a static in-bounds slice.
+        m, n = op.m, op.n
+        lo = min(op.offsets)
+        hi = max(op.offsets)
+        left = max(0, hi)
+        right = max(0, n - m - lo)
+        V = jnp.pad(op.diags * y[None, :], ((0, 0), (left, right)))
+        out = jnp.zeros((n,), y.dtype)
+        for b, d in enumerate(op.offsets):
+            out = out + jax.lax.slice(V, (b, left - d), (b + 1, left - d + n)
+                                      )[0]
+        if op.ell is not None:
+            out = out + op_rmatvec(op.ell, y, prec)
+        return out
     out = jnp.sum(op.data_t * y[op.cols_t], axis=-1)
     if op.dense_blk.shape[1]:
         out = out.at[op.dense_idx].add(
@@ -662,7 +779,7 @@ def is_pallas_compile_failure(e: Exception) -> bool:
     return any(sig in msg for sig in _PALLAS_COMPILE_SIGNATURES)
 
 
-def pallas_compiler_options(opts: "PDHGOptions"):
+def pallas_compiler_options(opts: "PDHGOptions", op=None):
     """Per-jit XLA options for programs that may embed the fused Pallas
     chunk kernel.  Embedded in a jitted program, XLA allocates the custom
     call's operands + Mosaic's double-buffered blocks on the scoped-VMEM
@@ -678,9 +795,20 @@ def pallas_compiler_options(opts: "PDHGOptions"):
     shapes and still overflowed), so the cap must comfortably exceed the
     promotion set.  Measured fitting on v5e (128 MB physical VMEM); on a
     backend where it still overflows, the error is a graceful
-    'scoped vmem' rejection that the runtime fallback catches."""
+    'scoped vmem' rejection that the runtime fallback catches.
+
+    With ``op`` given, the raise is attached ONLY when the kernel would
+    actually be embedded (supports()): since the promotion heuristic
+    expands with the limit, raising it on a pure scan/ELL program could
+    make a program that compiles fine under the default overflow — and
+    the fallback handler would rightly refuse to retry it."""
     if not opts.pallas_chunk or jax.default_backend() != "tpu":
         return None
+    if op is not None:
+        from . import pallas_chunk
+        if not pallas_chunk.supports(op, opts.dtype, opts.precision,
+                                     ignore_runtime_disabled=True):
+            return None
     return {"xla_tpu_scoped_vmem_limit_kib": "98304"}
 
 
@@ -741,7 +869,7 @@ class CompiledLPSolver:
         self._jit_chunk_b = jax.jit(jax.vmap(self._solve.run_chunk,
                                              in_axes=data_axes + (None, 0, None)),
                                     compiler_options=pallas_compiler_options(
-                                        self.opts))
+                                        self.opts, self.op))
         self._jit_fin_b = jax.jit(jax.vmap(self._solve.finalize,
                                            in_axes=data_axes + (0,)))
 
@@ -797,10 +925,13 @@ class CompiledLPSolver:
             return self._drive_inner(c, q, l, u, batched)
         except Exception as e:
             from . import pallas_chunk
+            # ignore_runtime_disabled: the failing program was TRACED
+            # before a concurrent thread may have flipped the kill switch
             kernel_in_play = (self.opts.pallas_chunk and batched
                               and pallas_chunk.supports(
                                   self.op, self.opts.dtype,
-                                  self.opts.precision))
+                                  self.opts.precision,
+                                  ignore_runtime_disabled=True))
             if not (kernel_in_play and is_pallas_compile_failure(e)):
                 raise
             disable_pallas_runtime(e)

@@ -78,7 +78,8 @@ def solve_batch_sharded(solver: CompiledLPSolver, mesh: Mesh,
         kernel_in_play = (solver.opts.pallas_chunk
                           and pallas_chunk.supports(
                               solver.op, solver.opts.dtype,
-                              solver.opts.precision))
+                              solver.opts.precision,
+                              ignore_runtime_disabled=True))
         if not (kernel_in_play and is_pallas_compile_failure(e)):
             raise
         disable_pallas_runtime(e)
@@ -144,7 +145,7 @@ def _solve_batch_sharded_inner(solver: CompiledLPSolver, mesh: Mesh,
     sh_chunk = jax.jit(jax.shard_map(
         local_chunk, mesh=mesh,
         in_specs=(P(AXIS),) * 4 + (P(AXIS), P()), out_specs=P(AXIS)),
-        compiler_options=pallas_compiler_options(solver.opts))
+        compiler_options=pallas_compiler_options(solver.opts, solver.op))
     sh_fin = jax.jit(jax.shard_map(
         local_fin, mesh=mesh, in_specs=(P(AXIS),) * 4 + (P(AXIS), P(AXIS)),
         out_specs=(res_specs, ShardedStats(n_converged=P(), max_iters=P(),

@@ -57,3 +57,65 @@ def test_input_runs_end_to_end(name):
     inst = res.instances[0]
     assert inst.time_series_data is not None
     assert len(inst.time_series_data)
+
+
+# ---------------------------------------------------------------------------
+# CPU-vs-jax parity across the feature matrix (VERDICT r3 #3): for every
+# runnable reference input, the TPU-path solver (PDHG, backend="jax") must
+# agree with the exact CPU solver (HiGHS) at the NPV and proforma level —
+# converting "the two blessed golden cases prove the jax path" into "the
+# jax path is proven wherever the CPU path is".
+# ---------------------------------------------------------------------------
+
+def runnable_csvs():
+    return [n for n in all_csvs()
+            if n not in EXPECT_ERROR and n not in MISSING_DATA]
+
+
+# Inputs whose OPTIMUM is degenerate across value streams, so per-column
+# proforma attribution is non-unique: 027 prices SR and NSR identically,
+# making the reserve-capacity split (and the ICE energy/reserve allocation
+# feeding DA ETS) a face of optima — HiGHS returns a vertex (all SR),
+# PDHG the face center (50/50), with window-objective totals and NPV
+# agreeing to 5e-5 (triaged r4).  For these, parity is asserted on NPV
+# and on each year's NET proforma row instead of per column.
+DEGENERATE_SPLIT = {"027-DA_FR_SR_NSR_pv_ice_month.csv"}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", runnable_csvs())
+def test_backend_parity_cpu_vs_jax(name):
+    import numpy as np
+
+    path = MP / name
+    try:
+        res_c = DERVET(path, base_path=REF).solve(backend="cpu")
+    except (ModelParameterError, TimeseriesDataError) as e:
+        pytest.skip(f"input not runnable here: {e}")
+    res_j = DERVET(path, base_path=REF).solve(backend="jax")
+    assert res_c.instances.keys() == res_j.instances.keys()
+    for key in res_c.instances:
+        ic, ij = res_c.instances[key], res_j.instances[key]
+        npv_c = float(ic.npv_df["Lifetime Present Value"].iloc[0])
+        npv_j = float(ij.npv_df["Lifetime Present Value"].iloc[0])
+        scale = max(1.0, abs(npv_c))
+        assert abs(npv_j - npv_c) / scale < 1e-2, \
+            (name, key, npv_c, npv_j)
+        # proforma: every shared numeric column agrees to 1% of its own
+        # magnitude (alternate optima can shuffle pennies between value
+        # streams; the 1% bound is the reference's own golden tolerance)
+        pc, pj = ic.proforma_df, ij.proforma_df
+        assert list(pc.columns) == list(pj.columns), (name, key)
+        if name in DEGENERATE_SPLIT:
+            num_c = pc.select_dtypes("number")
+            a = np.asarray(num_c.sum(axis=1), float)
+            b = np.asarray(pj[num_c.columns].sum(axis=1), float)
+            row_scale = max(1.0, np.nanmax(np.abs(a)))
+            assert np.nanmax(np.abs(a - b)) / row_scale < 1e-2, (name, key)
+            continue
+        for col in pc.columns:
+            a = np.asarray(pc[col], float)
+            b = np.asarray(pj[col], float)
+            col_scale = max(1.0, np.nanmax(np.abs(a)) if a.size else 1.0)
+            worst = np.nanmax(np.abs(a - b)) / col_scale if a.size else 0.0
+            assert worst < 1e-2, (name, key, col, worst)

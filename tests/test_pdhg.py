@@ -5,6 +5,7 @@ tests (SURVEY.md §4: "add real unit tests around the new LP kernel — PDHG
 vs. reference solver on small problems").
 """
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -337,6 +338,78 @@ class TestCpuStragglerRescue:
         res = CompiledLPSolver(lp, opts).solve(c=C)
         # none converge in 256 iterations and none may be rescued
         assert not bool(np.asarray(res.converged).any())
+
+
+class TestBandedOp:
+    """Diagonal-band decomposition for large time-structured LPs
+    (VERDICT r3 #5 enabler): the ELL gather matvec measured ~5 ms per
+    105k-step year on TPU; static shifted slices measured ~0.1 ms.  Both
+    directions must match scipy exactly, bands + residual + dense-column
+    block composing correctly."""
+
+    def _check(self, K, expect):
+        import scipy.sparse as sp  # noqa: F401
+
+        from dervet_tpu.ops.pdhg import make_op, op_matvec, op_rmatvec
+
+        rng = np.random.default_rng(0)
+        op = make_op(K.tocsr(), dense_bytes_limit=0)
+        assert type(op).__name__ == expect, type(op).__name__
+        m, n = K.shape
+        x = rng.standard_normal(n)
+        y = rng.standard_normal(m)
+        hi = jax.lax.Precision.HIGHEST
+        a = np.asarray(op_matvec(op, jnp.asarray(x, jnp.float32), hi))
+        np.testing.assert_allclose(a, K @ x, rtol=2e-5, atol=1e-4)
+        at = np.asarray(op_rmatvec(op, jnp.asarray(y, jnp.float32), hi))
+        np.testing.assert_allclose(at, K.T @ y, rtol=2e-5, atol=1e-4)
+
+    def test_soe_structure_goes_banded(self):
+        import scipy.sparse as sp
+        T = 2000
+        D = sp.diags([np.ones(T), -0.9 * np.ones(T - 1)], [0, -1])
+        Z = sp.hstack([D, -0.8 * sp.eye(T), 0.5 * sp.eye(T)])
+        self._check(Z.tocsr(), "BandedOp")
+
+    def test_aggregation_rows_ride_residual_ell(self):
+        import scipy.sparse as sp
+        rng = np.random.default_rng(3)
+        T = 2000
+        D = sp.diags([np.ones(T), -0.9 * np.ones(T - 1)], [0, -1])
+        Z = sp.hstack([D, -0.8 * sp.eye(T), 0.5 * sp.eye(T)])
+        agg = sp.coo_matrix(
+            (np.ones(300), (np.zeros(300, int),
+                            rng.choice(3 * T, 300, replace=False))),
+            shape=(1, 3 * T))
+        op_k = sp.vstack([Z, agg]).tocsr()
+        from dervet_tpu.ops.pdhg import make_op
+        op = make_op(op_k, dense_bytes_limit=0)
+        assert op.ell is not None       # residual engaged
+        self._check(op_k, "BandedOp")
+
+    def test_unstructured_falls_back_to_ell(self):
+        import scipy.sparse as sp
+        R = sp.random(1500, 4000, density=0.002, random_state=3)
+        self._check(R.tocsr(), "EllOp")
+
+    @pytest.mark.slow
+    def test_banded_solve_matches_dense_and_highs(self):
+        """End-to-end: force the banded path on the canonical battery LP
+        and match the dense path and HiGHS.  Slow: a T=1024 window needs
+        tens of thousands of scan-path iterations on the CPU platform."""
+        from dervet_tpu.ops.cpu_ref import solve_lp_cpu
+        from dervet_tpu.ops.pdhg import BandedOp, CompiledLPSolver, \
+            PDHGOptions
+
+        lp = battery_like_lp(T=1024)    # bands need >= 256 entries each
+        s_banded = CompiledLPSolver(lp, PDHGOptions(dense_bytes_limit=0))
+        assert isinstance(s_banded.op, BandedOp)
+        res_b = s_banded.solve()
+        res_d = CompiledLPSolver(lp, PDHGOptions()).solve()
+        ref = solve_lp_cpu(lp).obj
+        for r in (res_b, res_d):
+            assert bool(np.asarray(r.converged))
+            assert abs(float(r.obj) - ref) / max(1.0, abs(ref)) < 1e-3
 
 
 def test_widened_bounds_with_default_q_rejected():
