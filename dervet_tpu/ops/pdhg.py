@@ -61,6 +61,28 @@ if not os.environ.get("DERVET_TPU_NO_XLA_CACHE"):
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:                       # never let caching break solves
         pass
+
+
+_cache_backend_checked = False
+
+
+def _disable_cache_if_cpu() -> None:
+    """CPU programs must NOT use the persistent cache on this platform:
+    the remote-compile terminal AOT-compiles XLA:CPU executables with the
+    COMPILE machine's feature set, and reloading them on a host with
+    different features can SIGILL (the loader itself warns; observed
+    killing a --runslow pytest run).  TPU executables are
+    device-targeted and safe.  Called once the backend is known —
+    checking at import would itself initialize the backend."""
+    global _cache_backend_checked
+    if _cache_backend_checked:
+        return
+    _cache_backend_checked = True
+    try:
+        if jax.default_backend() != "tpu":
+            jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        pass
 import numpy as np
 import scipy.sparse as sp
 
@@ -215,7 +237,7 @@ def _build_ell(K_csr, dense_cols, blk, dtype) -> EllOp:
 def make_op(K_scaled, dense_bytes_limit: int = 32 * 1024 * 1024,
             dtype=jnp.float32, dense_col_factor: int = 16,
             max_bands: int = 48) -> MatOp:
-    """Pick dense vs banded vs ELL for the (Ruiz-scaled) constraint matrix.
+    """Pick banded vs dense vs ELL for the (Ruiz-scaled) constraint matrix.
 
     Large dispatch LPs are time-structured: nearly all nonzeros lie on a
     handful of diagonals j - i = d, which BandedOp turns into static
@@ -223,10 +245,15 @@ def make_op(K_scaled, dense_bytes_limit: int = 32 * 1024 * 1024,
     matvec on TPU; the banded path ~0.1 ms).  Bands carrying at least
     ``m / 64`` entries (up to ``max_bands``) are extracted; the leftover
     entries — aggregation rows, irregular requirement rows — ride a
-    residual ELL op only if they exist."""
+    residual ELL op only if they exist.
+
+    BANDED IS PREFERRED EVEN WHEN DENSE FITS when the bands absorb
+    ≥95% of nnz: a dense MXU matmul spends m×n FLOPs on a matrix with
+    ~nb×m real entries (~400x waste at bench shapes) — the vmapped
+    banded path measured 23% faster than dense + the fused Pallas
+    kernel at the 7000-instance bench group (PERF.md r4)."""
     m, n = K_scaled.shape
-    if m * n * jnp.dtype(dtype).itemsize <= dense_bytes_limit:
-        return DenseOp(Kh=jnp.asarray(K_scaled.todense(), dtype))
+    dense_fits = m * n * jnp.dtype(dtype).itemsize <= dense_bytes_limit
     csc = K_scaled.tocsc()
     col_nnz = np.diff(csc.indptr)
     mean_nnz = max(col_nnz.mean(), 1.0)
@@ -251,8 +278,20 @@ def make_op(K_scaled, dense_bytes_limit: int = 32 * 1024 * 1024,
         order = np.argsort(counts[np.isin(uniq, cand)])[::-1]
         cand = cand[order[:max_bands]]
     on_band = np.isin(offs, cand)
-    # banded only pays off if it absorbs the bulk of the matrix
-    if len(cand) == 0 or on_band.sum() < 0.5 * max(len(offs), 1):
+    n_on_band = int(on_band.sum())
+    coverage = n_on_band / max(len(offs), 1)
+    # dense-fits matrices switch to banded only when the decomposition is
+    # COMPLETE (no residual ELL, no dense-column block): a residual would
+    # disqualify the fused banded Pallas kernel (pallas_chunk.supports),
+    # silently trading the measured 23% win for the HBM-bound scan path.
+    # When dense does not fit, banded must still absorb the bulk to beat
+    # ELL — a residual is fine there, ELL was the alternative anyway.
+    banded_complete = (len(cand) > 0 and n_on_band == len(offs)
+                       and not len(dense_cols))
+    if (dense_fits and not banded_complete) \
+            or len(cand) == 0 or coverage < 0.5:
+        if dense_fits:
+            return DenseOp(Kh=jnp.asarray(K_scaled.todense(), dtype))
         return _build_ell(sparse_part, dense_cols, blk, dtype)
     offsets = tuple(int(v) for v in cand)
     band_pos = {d: b for b, d in enumerate(offsets)}
@@ -806,8 +845,11 @@ def pallas_compiler_options(opts: "PDHGOptions", op=None):
         return None
     if op is not None:
         from . import pallas_chunk
-        if not pallas_chunk.supports(op, opts.dtype, opts.precision,
-                                     ignore_runtime_disabled=True):
+        # consult the LIVE kill switch here (unlike the compile-failure
+        # handlers): once the kernel is disabled, newly built jits trace
+        # the scan path, and attaching the raise to a pure scan program
+        # is exactly the hazard described above
+        if not pallas_chunk.supports(op, opts.dtype, opts.precision):
             return None
     return {"xla_tpu_scoped_vmem_limit_kib": "98304"}
 
@@ -832,6 +874,7 @@ class CompiledLPSolver:
     """
 
     def __init__(self, lp: LP, opts: Optional[PDHGOptions] = None):
+        _disable_cache_if_cpu()
         self.opts = opts or PDHGOptions()
         self.lp = lp
         dtype = self.opts.dtype
@@ -840,20 +883,19 @@ class CompiledLPSolver:
         self.op = make_op(Kh_sp, self.opts.dense_bytes_limit, dtype)
         self.dr = jnp.asarray(d_r, dtype)
         self.dc = jnp.asarray(d_c, dtype)
-        # power iteration for ||Kh||_2
+        # power iteration for ||Kh||_2 on the HOST (scipy, f64): the
+        # matvec chain is O(nnz * power_iters) ≈ milliseconds even at the
+        # 420k-variable year LP, while the former on-device scan paid a
+        # full XLA compile per structure (~40 s cold on the remote chip
+        # for the year LP — the dominant precondition cost, r4)
         v = np.random.default_rng(0).standard_normal(lp.n)
-        v = jnp.asarray(v / np.linalg.norm(v), dtype)
-        op = self.op
-
-        prec = self.opts.precision
-
-        def piter(v, _):
-            w = op_rmatvec(op, op_matvec(op, v, prec), prec)
-            nw = jnp.linalg.norm(w)
-            return w / jnp.maximum(nw, 1e-30), nw
-
-        _, norms = jax.lax.scan(piter, v, None, length=self.opts.power_iters)
-        sigma_max = float(jnp.sqrt(norms[-1]))
+        v /= np.linalg.norm(v)
+        sigma_sq = 1e-24
+        for _ in range(self.opts.power_iters):
+            w = Kh_sp.T @ (Kh_sp @ v)
+            sigma_sq = float(np.linalg.norm(w))
+            v = w / max(sigma_sq, 1e-30)
+        sigma_max = float(np.sqrt(sigma_sq))
         self.eta = jnp.asarray(self.opts.step_size_safety / max(sigma_max, 1e-12), dtype)
         self._make_jits()
 

@@ -853,13 +853,13 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
             f"cross-case batching: {sum(len(g) for g in groups.values())} "
             f"windows from {len(scenarios)} case(s) in {len(groups)} "
             "pre-group(s)")
-    for s in scenarios:
-        # per-case membership count AND the dispatch-wide group count: the
-        # latter is the observable that proves cross-case sharing (4 cases
-        # x 12 windows in 3 groups, not 12 per-case groups)
-        s.solve_metadata["structure_groups_total"] = sum(
-            any(m is s for m, _ in items) for items in groups.values())
-        s.solve_metadata["dispatch_groups_total"] = len(groups)
+    # per-case membership count AND the dispatch-wide group count are the
+    # observables that prove cross-case sharing (4 cases x 12 windows in
+    # 3 groups, not 12 per-case groups); they are recorded from the
+    # VERIFIED byte-level subgroups below, not the cheap pre-groups — if
+    # a swept parameter starts entering K, the fan-out shows up here
+    exact_keys_all: set = set()
+    exact_keys_by_case: Dict[int, set] = {}
 
     def solve_only(key, items):
         lps = [lp for (_, _, lp) in items]
@@ -889,8 +889,10 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
                  for s, ctx in members]
         subgroups: Dict[tuple, list] = {}
         for item in items:
-            subgroups.setdefault(
-                MicrogridScenario._structure_key(item[2]), []).append(item)
+            k = MicrogridScenario._structure_key(item[2])
+            subgroups.setdefault(k, []).append(item)
+            exact_keys_all.add(k)
+            exact_keys_by_case.setdefault(id(item[0]), set()).add(k)
         return subgroups
 
     if backend == "cpu":
@@ -954,4 +956,7 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
         # builds == window steps
         s.solve_metadata["solver_builds"] = cache.builds
         s.solve_metadata["solver_cache_hits"] = cache.hits
+        s.solve_metadata["structure_groups_total"] = len(
+            exact_keys_by_case.get(id(s), ()))
+        s.solve_metadata["dispatch_groups_total"] = len(exact_keys_all)
         s.finish_dispatch()

@@ -14,6 +14,10 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+# never persist/reload XLA:CPU executables in tests: the remote-compile
+# terminal AOT-compiles them with the COMPILE machine's CPU features and
+# reloading on this host can SIGILL (killed a --runslow run, r4)
+os.environ["DERVET_TPU_NO_XLA_CACHE"] = "1"
 
 import jax  # noqa: E402
 

@@ -73,13 +73,23 @@ def runnable_csvs():
 
 
 # Inputs whose OPTIMUM is degenerate across value streams, so per-column
-# proforma attribution is non-unique: 027 prices SR and NSR identically,
-# making the reserve-capacity split (and the ICE energy/reserve allocation
-# feeding DA ETS) a face of optima — HiGHS returns a vertex (all SR),
-# PDHG the face center (50/50), with window-objective totals and NPV
-# agreeing to 5e-5 (triaged r4).  For these, parity is asserted on NPV
-# and on each year's NET proforma row instead of per column.
-DEGENERATE_SPLIT = {"027-DA_FR_SR_NSR_pv_ice_month.csv"}
+# proforma attribution is non-unique — HiGHS returns a vertex, PDHG a
+# face point, with window-objective totals and NPV agreeing (verified at
+# triage, r4).  For these, parity is asserted on NPV and on each year's
+# NET proforma row instead of per column.
+DEGENERATE_SPLIT = {
+    # SR and NSR priced identically: reserve-capacity split (and the ICE
+    # energy/reserve allocation feeding DA ETS) is a face of optima;
+    # totals agree to 5e-5
+    "027-DA_FR_SR_NSR_pv_ice_month.csv",
+    # DA energy vs SR reserve marginal-value ties shift ~1.6% of DA ETS
+    # between the two streams; objective totals agree to 2e-5
+    "008-sr_battery_multiyr.csv",
+    # FR/SR/NSR capacity all priced: CPU assigns the capacity revenue to
+    # one stream, PDHG splits it; 'DA ETS' differs by $15 ABSOLUTE on a
+    # $15-scale column; objective totals agree to 1e-8
+    "029-DA_FR_SR_NSR_battery_month_ts_constraints.csv",
+}
 
 
 @pytest.mark.slow
