@@ -29,6 +29,12 @@ def main(argv=None):
     parser.add_argument("--checkpoint-dir", default=None,
                         help="directory for per-window solve checkpoints "
                              "(resume an interrupted run from here)")
+    # reference-CLI compatibility (run_DERVET.py:53-54): the reference
+    # prompts for input unless --gitlab-ci is given; this CLI never
+    # prompts, so the flag is accepted as a no-op
+    parser.add_argument("--gitlab-ci", action="store_true",
+                        help="accepted for reference-CLI compatibility "
+                             "(this CLI is always non-interactive)")
     args = parser.parse_args(argv)
 
     case = DERVET(args.parameters_filename, verbose=args.verbose,

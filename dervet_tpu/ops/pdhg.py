@@ -1012,9 +1012,10 @@ class CompiledLPSolver:
         # ill-conditioned stragglers (e.g. extreme sizing-sweep
         # candidates at 20x the median iteration count) would otherwise
         # bill their iterations to the entire batch.  Once most of the
-        # batch is done, gather the survivors into a power-of-2 bucket
-        # (bounding recompiles) and keep iterating only those; scatter
-        # results back before finalizing on the full batch.
+        # batch is done, gather the survivors into a 4x-step bucket
+        # ({8, 32, 128, ...} — bounding recompiles) and keep iterating
+        # only those; scatter results back before finalizing on the
+        # full batch.
         B = c.shape[0]
         idx = np.arange(B)            # sub-batch row -> original position
         cur = (c, q, l, u)
@@ -1042,7 +1043,15 @@ class CompiledLPSolver:
                 if n_distinct <= min(self.opts.cpu_rescue_max,
                                      max(1, B // 8)):
                     break     # hand the straggler minority to the CPU
-            bucket = max(8, 1 << (max(n_active - 1, 0).bit_length()))
+            # 4x bucket steps ({8, 32, 128, 512, ...}), not powers of 2:
+            # each distinct bucket size is a separate XLA compile of the
+            # chunk program (~0.9 s over a remote-compile tunnel), and a
+            # cold product run pays them per structure group — halving
+            # the shape count beats the ≤4x padding of a few stragglers
+            # whose extra rows are masked anyway
+            bucket = 8
+            while bucket < n_active:
+                bucket <<= 2
             if bucket <= len(idx) // 2:
                 act = ~(np.asarray(cur_state.converged)
                         | np.asarray(cur_state.infeasible))

@@ -909,9 +909,23 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
         # THIS thread (apply_subgroup mutates per-case state), and
         # in-flight work is bounded so peak LP memory stays a few
         # subgroups, not the whole sweep.
+        #
+        # MULTI-DEVICE: solve_group routes to shard_map there, and TWO
+        # sharded programs launched from different threads interleave
+        # their collectives on the same device set — the runtime aborts
+        # the whole process (observed as 'Fatal Python error: Aborted'
+        # inside the jax golden tests on the 8-virtual-device platform).
+        # One worker still pipelines host assembly against the in-flight
+        # solve; only the CONCURRENT-solve axis is given up.  This also
+        # forfeits multi-device compile overlap — acceptable: the
+        # single-accelerator case (the bench/driver environment) keeps
+        # the full 3-way pipeline, and a finer fix (compile-then-lock
+        # around execution only) isn't worth the machinery until a real
+        # multi-chip deployment profiles as compile-bound.
         import collections
         import concurrent.futures as cf
-        max_inflight = 3
+        import jax
+        max_inflight = 1 if len(jax.devices()) > 1 else 3
         with cf.ThreadPoolExecutor(max_workers=max_inflight) as pool:
             futs = collections.deque()
             while groups:

@@ -5,14 +5,17 @@
 # tunnel shows +/-15% run-to-run noise).
 set -euo pipefail
 
-echo "=== 1. default test suite (~5 min; expect ~261 passed) ==="
+echo "=== 1. default test suite (~7 min; expect ~280 passed) ==="
 python -m pytest tests/ -x -q
 
-echo "=== 2. full suite incl. slow golden legs (~25 min; expect ~304 passed) ==="
+echo "=== 2. full suite incl. slow golden + CPU-vs-jax parity sweep"
+echo "       (~35 min; expect ~355 passed) ==="
 python -m pytest tests/ -q --runslow
 
-echo "=== 3. north-star bench (expect steady-state ~9s, vs_baseline ~6.5x,"
-echo "       12000/12000 converged; warm-up <60s cold) ==="
+echo "=== 3. north-star bench + product-scale legs (expect steady-state"
+echo "       ~2.5-3s, vs_baseline ~20-25x, pallas:true, 24000/24000"
+echo "       converged; sensitivity leg NPV parity <1e-2; long-horizon"
+echo "       chip warm ~4-5s vs HiGHS ~6-20s at obj rel err ~6e-8) ==="
 DERVET_TPU_NO_XLA_CACHE=1 python bench.py
 
 REF="${DERVET_REFERENCE:-/root/reference}"
