@@ -124,6 +124,23 @@ def _build_call(m: int, n: int, iters: int, grid: int, blk: int):
     )
 
 
+def _banded_blk(op) -> Optional[int]:
+    """Instance-block size for the banded kernel, or None if unsupported.
+
+    Unlike the dense kernel — MXU-bound, where a 64-row block half-fills
+    the 128-wide systolic array and loses to the scan path — the banded
+    kernel is VPU-elementwise, so a smaller block only shrinks VMEM
+    footprint.  128 when it fits the per-step envelope, else 64 (lets
+    wide multi-DER windows like n≈6k on the kernel), else decline."""
+    if op.ell is not None or len(op.offsets) > 32:
+        return None
+    nb = len(op.offsets)
+    for blk in (BLK, BLK // 2):
+        if nb * op.m * 4 + blk * (9 * op.n + 5 * op.m) * 4 <= MAX_STEP_BYTES:
+            return blk
+    return None
+
+
 def _banded_chunk_kernel(iters: int, offsets: tuple, m: int, n: int,
                          c_ref, q_ref, l_ref, u_ref, tau_ref, sig_ref,
                          x_ref, y_ref, xs_ref, ys_ref, d_ref, fl_ref,
@@ -238,13 +255,7 @@ def supports(op, dtype, precision=None, backend: Optional[str] = None,
     if backend != "tpu" or dtype != jnp.float32:
         return False
     if isinstance(op, BandedOp):
-        if op.ell is not None or len(op.offsets) > 32:
-            return False
-        # no K resident — only the (nb, m) diags + blocked operands and
-        # the in-kernel pad scratch (~2 extra x-space blocks)
-        nb = len(op.offsets)
-        step = nb * op.m * 4 + BLK * (9 * op.n + 5 * op.m) * 4
-        return step <= MAX_STEP_BYTES
+        return _banded_blk(op) is not None
     if not isinstance(op, DenseOp):
         return False
     mm, nn = op.Kh.shape
@@ -266,7 +277,9 @@ def batched_chunk(op, c, q, l, u, omega, eta, x, y, xs, ys,
     B = x.shape[0]
     banded = isinstance(op, BandedOp)
     m, n = (op.m, op.n) if banded else op.Kh.shape
-    blk = BLK
+    blk = _banded_blk(op) if banded else BLK
+    assert blk is not None, \
+        "batched_chunk called with a banded op that supports() declines"
     grid = -(-B // blk)
     pad = grid * blk - B
 

@@ -451,6 +451,24 @@ def test_banded_kernel_support_gate():
                                      ignore_runtime_disabled=True)
     finally:
         pallas_chunk.RUNTIME_DISABLED = False
+    # wide multi-DER-like shapes that blow the 128-row envelope drop to a
+    # 64-row block (the banded kernel is VPU-bound, so a half block only
+    # shrinks VMEM); beyond even that, the gate declines
+    assert pallas_chunk._banded_blk(op) == 128
+    Tw = 2100          # n = 3*Tw = 6300: fails blk=128, fits blk=64
+    Dw = sp.diags([np.ones(Tw), -0.9 * np.ones(Tw - 1)], [0, -1])
+    Zw = sp.hstack([Dw, -0.8 * sp.eye(Tw), 0.5 * sp.eye(Tw)]).tocsr()
+    op_w = make_op(Zw, dense_bytes_limit=0)
+    assert isinstance(op_w, BandedOp) and op_w.ell is None
+    assert pallas_chunk._banded_blk(op_w) == 64
+    assert pallas_chunk.supports(op_w, jnp.float32, backend="tpu")
+    Th = 9000          # n = 27000: fails both block sizes
+    Dh = sp.diags([np.ones(Th), -0.9 * np.ones(Th - 1)], [0, -1])
+    Zh = sp.hstack([Dh, -0.8 * sp.eye(Th), 0.5 * sp.eye(Th)]).tocsr()
+    op_h = make_op(Zh, dense_bytes_limit=0)
+    if isinstance(op_h, BandedOp):
+        assert pallas_chunk._banded_blk(op_h) is None
+        assert not pallas_chunk.supports(op_h, jnp.float32, backend="tpu")
 
 
 def test_make_op_prefers_banded_over_dense_when_covered():
