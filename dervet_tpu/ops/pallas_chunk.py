@@ -131,26 +131,47 @@ def _banded_blk(op) -> Optional[int]:
     the 128-wide systolic array and loses to the scan path — the banded
     kernel is VPU-elementwise, so a smaller block only shrinks VMEM
     footprint.  128 when it fits the per-step envelope, else 64 (lets
-    wide multi-DER windows like n≈6k on the kernel), else decline."""
+    wide multi-DER windows like n≈6k on the kernel), else decline.
+
+    A low-rank wide-row pair (daily-cycle aggregation rows) is supported
+    — its (m, r) selector + (r, n) values are VMEM-resident next to the
+    diagonals and cost two small MXU matmuls per direction.  An ELL
+    residual is not: its gather is the thing the banded path avoids."""
     if op.ell is not None or len(op.offsets) > 32:
         return None
     nb = len(op.offsets)
+    wide_bytes = 0
+    if op.wide_w is not None:
+        r = op.wide_w.shape[0]
+        wide_bytes = (op.m * r + r * op.n) * 4
     for blk in (BLK, BLK // 2):
-        if nb * op.m * 4 + blk * (9 * op.n + 5 * op.m) * 4 <= MAX_STEP_BYTES:
+        if nb * op.m * 4 + wide_bytes \
+                + blk * (9 * op.n + 5 * op.m) * 4 <= MAX_STEP_BYTES:
             return blk
     return None
 
 
 def _banded_chunk_kernel(iters: int, offsets: tuple, m: int, n: int,
-                         c_ref, q_ref, l_ref, u_ref, tau_ref, sig_ref,
-                         x_ref, y_ref, xs_ref, ys_ref, d_ref, fl_ref,
-                         xo_ref, yo_ref, xso_ref, yso_ref):
+                         has_wide: bool, *refs):
     """Banded variant of ``_chunk_kernel``: the constraint matrix is a
     handful of diagonals (j - i = d), so both matvecs are static shifted
     slices + elementwise FMAs on the VPU — ~nb*m MACs per instance per
     direction instead of the dense kernel's m*n (≈400x fewer at bench
     shapes), and only (nb, m) of matrix data resident instead of (m, n).
-    Mirrors ops/pdhg.py::op_matvec/op_rmatvec for BandedOp exactly."""
+    With ``has_wide``, a low-rank wide-row pair (K_wide = P @ W, the
+    daily-cycle aggregation rows) adds two small MXU matmuls per
+    direction.  Mirrors ops/pdhg.py::op_matvec/op_rmatvec for BandedOp
+    exactly."""
+    if has_wide:
+        (c_ref, q_ref, l_ref, u_ref, tau_ref, sig_ref,
+         x_ref, y_ref, xs_ref, ys_ref, d_ref, fl_ref, p_ref, w_ref,
+         xo_ref, yo_ref, xso_ref, yso_ref) = refs
+        P = p_ref[...]               # (m, r) 0/1 row selector
+        W = w_ref[...]               # (r, n) wide-row values
+    else:
+        (c_ref, q_ref, l_ref, u_ref, tau_ref, sig_ref,
+         x_ref, y_ref, xs_ref, ys_ref, d_ref, fl_ref,
+         xo_ref, yo_ref, xso_ref, yso_ref) = refs
     diags = d_ref[...]               # (nb, m) band values
     fl = fl_ref[...]                 # (1, m): -inf on eq rows, 0 on ge
     c = c_ref[...]
@@ -159,6 +180,7 @@ def _banded_chunk_kernel(iters: int, offsets: tuple, m: int, n: int,
     u = u_ref[...]
     tau = tau_ref[...]
     sig = sig_ref[...]
+    hi = jax.lax.Precision.HIGHEST
     lo, hi_off = min(offsets), max(offsets)
     # matvec pads (x-space window [d, d+m) must stay inside [0, n))
     mv_l = max(0, -lo)
@@ -174,6 +196,14 @@ def _banded_chunk_kernel(iters: int, offsets: tuple, m: int, n: int,
         for b, d in enumerate(offsets[1:], start=1):
             out = out + diags[b][None, :] * jax.lax.slice_in_dim(
                 xp, mv_l + d, mv_l + d + m, axis=1)
+        if has_wide:
+            # (BLK, n) @ W^T -> (BLK, r), then @ P^T -> (BLK, m)
+            xw = jax.lax.dot_general(x, W, (((1,), (1,)), ((), ())),
+                                     precision=hi,
+                                     preferred_element_type=jnp.float32)
+            out = out + jax.lax.dot_general(
+                xw, P, (((1,), (1,)), ((), ())), precision=hi,
+                preferred_element_type=jnp.float32)
         return out
 
     def rmatvec(y):                  # (BLK, m) -> (BLK, n)
@@ -182,6 +212,14 @@ def _banded_chunk_kernel(iters: int, offsets: tuple, m: int, n: int,
             v = jnp.pad(diags[b][None, :] * y, ((0, 0), (rm_l, rm_r)))
             term = jax.lax.slice_in_dim(v, rm_l - d, rm_l - d + n, axis=1)
             out = term if out is None else out + term
+        if has_wide:
+            # (BLK, m) @ P -> (BLK, r), then @ W -> (BLK, n)
+            yp = jax.lax.dot_general(y, P, (((1,), (0,)), ((), ())),
+                                     precision=hi,
+                                     preferred_element_type=jnp.float32)
+            out = out + jax.lax.dot_general(
+                yp, W, (((1,), (0,)), ((), ())), precision=hi,
+                preferred_element_type=jnp.float32)
         return out
 
     def it(_, carry):
@@ -200,17 +238,22 @@ def _banded_chunk_kernel(iters: int, offsets: tuple, m: int, n: int,
 
 @functools.lru_cache(maxsize=32)
 def _build_banded_call(m: int, n: int, nb: int, offsets: tuple, iters: int,
-                       grid: int, blk: int):
+                       grid: int, blk: int, r_wide: int = 0):
     blk_x = pl.BlockSpec((blk, n), lambda i: (i, 0))
     blk_y = pl.BlockSpec((blk, m), lambda i: (i, 0))
     blk_s = pl.BlockSpec((blk, 1), lambda i: (i, 0))
     shared_d = pl.BlockSpec((nb, m), lambda i: (0, 0))
     shared_f = pl.BlockSpec((1, m), lambda i: (0, 0))
+    in_specs = [blk_x, blk_y, blk_x, blk_x, blk_s, blk_s,
+                blk_x, blk_y, blk_x, blk_y, shared_d, shared_f]
+    if r_wide:
+        in_specs += [pl.BlockSpec((m, r_wide), lambda i: (0, 0)),
+                     pl.BlockSpec((r_wide, n), lambda i: (0, 0))]
     return pl.pallas_call(
-        functools.partial(_banded_chunk_kernel, iters, offsets, m, n),
+        functools.partial(_banded_chunk_kernel, iters, offsets, m, n,
+                          bool(r_wide)),
         grid=(grid,),
-        in_specs=[blk_x, blk_y, blk_x, blk_x, blk_s, blk_s,
-                  blk_x, blk_y, blk_x, blk_y, shared_d, shared_f],
+        in_specs=in_specs,
         out_specs=[blk_x, blk_y, blk_x, blk_y],
         out_shape=[
             jax.ShapeDtypeStruct((grid * blk, n), jnp.float32),
@@ -290,15 +333,19 @@ def batched_chunk(op, c, q, l, u, omega, eta, x, y, xs, ys,
     sig = (eta * omega)[:, None].astype(jnp.float32)
     floor = jnp.where(jnp.arange(m) < n_eq, -jnp.inf, 0.0)[None, :] \
         .astype(jnp.float32)
+    extra = ()
     if banded:
+        r_wide = 0 if op.wide_w is None else int(op.wide_w.shape[0])
         call = _build_banded_call(m, n, len(op.offsets), op.offsets,
-                                  iters, grid, blk)
+                                  iters, grid, blk, r_wide)
         mat = op.diags
+        if r_wide:
+            extra = (op.wide_p, op.wide_w)
     else:
         call = _build_call(m, n, iters, grid, blk)
         mat = op.Kh
     xo, yo, xso, yso = call(p(c), p(q), p(l), p(u), p(tau), p(sig),
-                            p(x), p(y), p(xs), p(ys), mat, floor)
+                            p(x), p(y), p(xs), p(ys), mat, floor, *extra)
     if pad:
         xo, yo, xso, yso = (a[:B] for a in (xo, yo, xso, yso))
     return xo, yo, xso, yso

@@ -103,22 +103,55 @@ STATUS_INACCURATE = 3
 # Preconditioning (host-side, numpy — runs once per problem structure)
 # ---------------------------------------------------------------------------
 
+def _segment_max(vals: np.ndarray, ptr: np.ndarray, out_len: int) -> np.ndarray:
+    """Max of ``vals`` over contiguous segments ``[ptr[i], ptr[i+1])``,
+    0.0 for empty segments.  reduceat runs only over NON-empty segment
+    starts: empty segments both break reduceat's indexing (a start ==
+    len(vals) raises; an empty segment returns the element at its start)
+    and, if merely clipped, truncate the preceding segment's extent
+    (a trailing empty row/column would silently drop the last segment's
+    tail entries from the max — caught by review r5).  Consecutive
+    non-empty starts still bound each reduction correctly because the
+    empty segments between them contain no elements."""
+    out = np.zeros(out_len)
+    if not len(vals):
+        return out
+    nonempty = np.nonzero(ptr[:-1] < ptr[1:])[0]
+    if len(nonempty):
+        out[nonempty] = np.maximum.reduceat(vals, ptr[:-1][nonempty])
+    return out
+
+
 def ruiz_scaling(K, iters: int = 10):
     """Iterated l-inf Ruiz equilibration.  Returns (d_r, d_c) with
-    K_hat = diag(d_r) @ K @ diag(d_c) approximately balanced."""
-    K = K.tocsr(copy=True)
-    m, n = K.shape
+    K_hat = diag(d_r) @ K @ diag(d_c) approximately balanced.
+
+    Runs on flat nnz vectors with precomputed row/col segment orders —
+    one reduceat per axis per iteration — instead of rebuilding scipy
+    matrices each pass (``abs(K)``, two ``multiply``, ``tocsr`` per iter
+    cost ~1 s at the 420k-nnz year LP; this form costs ~40 ms there)."""
+    csr = K.tocsr()
+    m, n = csr.shape
+    absd_row = np.abs(csr.data).astype(np.float64)     # CSR (row) order
+    row_ptr = csr.indptr
+    col_of = csr.indices
+    # column order: stable argsort of the column ids gives a CSC-ordered
+    # view of the same nnz; bincount gives the column segment pointers
+    perm = np.argsort(col_of, kind="stable")
+    col_ptr = np.concatenate(
+        ([0], np.cumsum(np.bincount(col_of, minlength=n))))
+    row_of = np.repeat(np.arange(m), np.diff(row_ptr))
     d_r = np.ones(m)
     d_c = np.ones(n)
     for _ in range(iters):
-        absK = abs(K)
-        row_max = absK.max(axis=1).toarray().ravel()
-        col_max = absK.max(axis=0).toarray().ravel()
+        row_max = _segment_max(absd_row, row_ptr, m)
+        col_max = _segment_max(absd_row[perm], col_ptr, n)
         r = 1.0 / np.sqrt(np.maximum(row_max, 1e-12))
         c = 1.0 / np.sqrt(np.maximum(col_max, 1e-12))
         r[row_max == 0] = 1.0
         c[col_max == 0] = 1.0
-        K = K.multiply(r[:, None]).multiply(c[None, :]).tocsr()
+        absd_row *= r[row_of]
+        absd_row *= c[col_of]
         d_r *= r
         d_c *= c
     return d_r, d_c
@@ -127,6 +160,13 @@ def ruiz_scaling(K, iters: int = 10):
 # ---------------------------------------------------------------------------
 # Matvec operators (dense | ELL sparse), vmap/jit-friendly pytrees
 # ---------------------------------------------------------------------------
+
+# residual rows admitted to BandedOp's low-rank wide-row pair instead of
+# an ELL residual: enough for a year of daily-cycle rows (366) while the
+# (r, n) value block stays comfortably VMEM-sized for the Pallas kernel
+WIDE_MAX_ROWS = 384
+WIDE_MAX_BYTES = 8 * 1024 * 1024
+
 
 class DenseOp(NamedTuple):
     Kh: jax.Array            # (m, n)
@@ -160,27 +200,37 @@ class BandedOp:
       K.T @ y:  out[j]  = sum_b diag_b[j - d_b] * y[j - d_b]   (same trick,
                  shifting the product diag_b * y — no transpose table)
 
-    Entries off the selected bands (monthly aggregation rows, requirement
-    rows with irregular column patterns) ride a residual ELLPACK op, and
+    A small set of WIDE rows (daily-cycle and other aggregation rows:
+    ~30 rows spanning a day of columns each in the monthly dispatch
+    windows) is carried as a low-rank pair ``K_wide = wide_p @ wide_w``
+    — ``wide_w`` (r, n) holds the row values, ``wide_p`` (m, r) is the
+    0/1 row selector — so both matvec directions are two tiny MXU
+    matmuls and the op remains eligible for the fused banded Pallas
+    kernel (an ELL residual is not, VERDICT r5 #1).  Any remaining
+    entries (irregular requirement rows) ride a residual ELLPACK op, and
     near-dense columns stay in its explicit dense block.  ``offsets`` is
     static python metadata (pytree aux), so the slices compile to fixed
     windows."""
 
-    def __init__(self, diags, offsets, m, n, ell=None):
+    def __init__(self, diags, offsets, m, n, ell=None,
+                 wide_p=None, wide_w=None):
         self.diags = diags          # (nb, m) band values
         self.offsets = offsets      # static tuple of int, j - i per band
         self.m = m
         self.n = n
         self.ell = ell              # residual EllOp or None
+        self.wide_p = wide_p        # (m, r) 0/1 row selector or None
+        self.wide_w = wide_w        # (r, n) wide-row values or None
 
     def tree_flatten(self):
-        return (self.diags, self.ell), (self.offsets, self.m, self.n)
+        return ((self.diags, self.ell, self.wide_p, self.wide_w),
+                (self.offsets, self.m, self.n))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        diags, ell = children
+        diags, ell, wide_p, wide_w = children
         offsets, m, n = aux
-        return cls(diags, offsets, m, n, ell)
+        return cls(diags, offsets, m, n, ell, wide_p, wide_w)
 
 
 class ShardRowOp(NamedTuple):
@@ -210,6 +260,25 @@ def _rnorm(v, axis):
     return jnp.sqrt(_psum_if(jnp.sum(v * v), axis))
 
 
+def _hcast(a, dtype=None):
+    """Cast on the HOST with numpy (no device program, no transfer)."""
+    a = np.asarray(a)
+    if dtype is not None and a.dtype != np.dtype(dtype):
+        a = a.astype(np.dtype(dtype), copy=False)
+    return a
+
+
+def _dput(a, dtype=None):
+    """Host-cast + ``device_put``: a plain transfer that never becomes a
+    device-side ``convert_element_type`` program.  ``jnp.asarray(x, dt)``
+    on a numpy array of a different dtype compiles a tiny convert per new
+    shape — nearly free locally, but a COLD compile on a remote-compile
+    backend costs 20-40 s of tunnel round-trip (the r4 long-horizon leg's
+    'precondition 55.6 s' was exactly these, VERDICT r5 #2).  A numpy cast
+    costs milliseconds at any shape."""
+    return jax.device_put(_hcast(a, dtype))
+
+
 def _csr_to_ell(K) -> tuple[np.ndarray, np.ndarray]:
     """CSR -> ELLPACK (data, cols) with rows padded to the max row nnz."""
     K = K.tocsr()
@@ -225,18 +294,18 @@ def _csr_to_ell(K) -> tuple[np.ndarray, np.ndarray]:
     return data, cols
 
 
-def _build_ell(K_csr, dense_cols, blk, dtype) -> EllOp:
+def _build_ell(K_csr, dense_cols, blk, dtype, put=_dput) -> EllOp:
     d, c = _csr_to_ell(K_csr)
     dt, ct = _csr_to_ell(K_csr.T.tocsr())
-    return EllOp(data=jnp.asarray(d, dtype), cols=jnp.asarray(c),
-                 data_t=jnp.asarray(dt, dtype), cols_t=jnp.asarray(ct),
-                 dense_idx=jnp.asarray(dense_cols, jnp.int32),
-                 dense_blk=jnp.asarray(blk, dtype))
+    return EllOp(data=put(d, dtype), cols=put(c),
+                 data_t=put(dt, dtype), cols_t=put(ct),
+                 dense_idx=put(dense_cols, jnp.int32),
+                 dense_blk=put(blk, dtype))
 
 
 def make_op(K_scaled, dense_bytes_limit: int = 32 * 1024 * 1024,
             dtype=jnp.float32, dense_col_factor: int = 16,
-            max_bands: int = 48) -> MatOp:
+            max_bands: int = 48, put=_dput) -> MatOp:
     """Pick banded vs dense vs ELL for the (Ruiz-scaled) constraint matrix.
 
     Large dispatch LPs are time-structured: nearly all nonzeros lie on a
@@ -280,19 +349,29 @@ def make_op(K_scaled, dense_bytes_limit: int = 32 * 1024 * 1024,
     on_band = np.isin(offs, cand)
     n_on_band = int(on_band.sum())
     coverage = n_on_band / max(len(offs), 1)
+    # residual entries confined to a FEW distinct rows (daily-cycle /
+    # aggregation rows: ~30 rows per monthly window) become the low-rank
+    # wide-row pair instead of an ELL residual — two tiny MXU matmuls,
+    # and the op keeps its fused-Pallas eligibility (VERDICT r5 #1)
+    resid_rows = np.unique(coo.row[~on_band]) if len(offs) else \
+        np.empty(0, np.int64)
+    r_wide = len(resid_rows)
+    wide_ok = (not len(dense_cols) and 0 < r_wide <= WIDE_MAX_ROWS
+               and r_wide * n * 8 <= WIDE_MAX_BYTES)
     # dense-fits matrices switch to banded only when the decomposition is
-    # COMPLETE (no residual ELL, no dense-column block): a residual would
-    # disqualify the fused banded Pallas kernel (pallas_chunk.supports),
-    # silently trading the measured 23% win for the HBM-bound scan path.
-    # When dense does not fit, banded must still absorb the bulk to beat
-    # ELL — a residual is fine there, ELL was the alternative anyway.
-    banded_complete = (len(cand) > 0 and n_on_band == len(offs)
-                       and not len(dense_cols))
+    # COMPLETE (no ELL residual, no dense-column block — wide rows are
+    # fine): an ELL residual would disqualify the fused banded Pallas
+    # kernel (pallas_chunk.supports), silently trading the measured 23%
+    # win for the HBM-bound scan path.  When dense does not fit, banded
+    # must still absorb the bulk to beat ELL — a residual is fine there,
+    # ELL was the alternative anyway.
+    banded_complete = (len(cand) > 0 and not len(dense_cols)
+                       and (n_on_band == len(offs) or wide_ok))
     if (dense_fits and not banded_complete) \
             or len(cand) == 0 or coverage < 0.5:
         if dense_fits:
-            return DenseOp(Kh=jnp.asarray(K_scaled.todense(), dtype))
-        return _build_ell(sparse_part, dense_cols, blk, dtype)
+            return DenseOp(Kh=put(K_scaled.todense(), dtype))
+        return _build_ell(sparse_part, dense_cols, blk, dtype, put)
     offsets = tuple(int(v) for v in cand)
     band_pos = {d: b for b, d in enumerate(offsets)}
     diags = np.zeros((len(offsets), m), np.float64)
@@ -300,14 +379,21 @@ def make_op(K_scaled, dense_bytes_limit: int = 32 * 1024 * 1024,
     diags[np.fromiter((band_pos[d] for d in offs[on_band]), np.int64,
                       int(on_band.sum())), rows_b] = coo.data[on_band]
     resid_nnz = int((~on_band).sum())
-    ell = None
-    if resid_nnz or len(dense_cols):
+    ell = wide_p = wide_w = None
+    if resid_nnz and wide_ok:
+        wp = np.zeros((m, r_wide))
+        wp[resid_rows, np.arange(r_wide)] = 1.0
+        ww = np.zeros((r_wide, n))
+        row_pos = np.searchsorted(resid_rows, coo.row[~on_band])
+        ww[row_pos, coo.col[~on_band]] = coo.data[~on_band]
+        wide_p, wide_w = put(wp, dtype), put(ww, dtype)
+    elif resid_nnz or len(dense_cols):
         resid = sp.coo_matrix(
             (coo.data[~on_band], (coo.row[~on_band], coo.col[~on_band])),
             shape=(m, n)).tocsr()
-        ell = _build_ell(resid, dense_cols, blk, dtype)
-    return BandedOp(diags=jnp.asarray(diags, dtype), offsets=offsets,
-                    m=m, n=n, ell=ell)
+        ell = _build_ell(resid, dense_cols, blk, dtype, put)
+    return BandedOp(diags=put(diags, dtype), offsets=offsets,
+                    m=m, n=n, ell=ell, wide_p=wide_p, wide_w=wide_w)
 
 
 def op_matvec(op: MatOp, x: jax.Array, prec) -> jax.Array:
@@ -327,6 +413,11 @@ def op_matvec(op: MatOp, x: jax.Array, prec) -> jax.Array:
         for b, d in enumerate(op.offsets):
             out = out + op.diags[b] * jax.lax.slice(
                 xp, (left + d,), (left + d + m,))
+        if op.wide_w is not None:
+            # low-rank wide rows: two tiny matmuls, no gather/scatter
+            out = out + jnp.matmul(
+                op.wide_p, jnp.matmul(op.wide_w, x, precision=prec),
+                precision=prec)
         if op.ell is not None:
             out = out + op_matvec(op.ell, x, prec)
         return out
@@ -355,6 +446,10 @@ def op_rmatvec(op: MatOp, y: jax.Array, prec) -> jax.Array:
         for b, d in enumerate(op.offsets):
             out = out + jax.lax.slice(V, (b, left - d), (b + 1, left - d + n)
                                       )[0]
+        if op.wide_w is not None:
+            out = out + jnp.matmul(
+                op.wide_w.T, jnp.matmul(op.wide_p.T, y, precision=prec),
+                precision=prec)
         if op.ell is not None:
             out = out + op_rmatvec(op.ell, y, prec)
         return out
@@ -377,7 +472,13 @@ class PDHGOptions:
     # host-chunked driver stops early), so the budget only matters for hard
     # windows — e.g. tightly floor-bound February retail windows need ~300k
     max_iters: int = 400_000
-    check_every: int = 64
+    # restart/termination check cadence: each check costs several full
+    # matvecs + HBM-bound elementwise over the whole batch state — at
+    # product shapes (m≈3k, B≈512) checking every 64 fused iterations
+    # spent more time checking than iterating (128 measured 20% faster
+    # end-to-end, r5); 256+ delays restarts enough to cost more
+    # iterations than the checks save
+    check_every: int = 128
     # restart scheme thresholds (simplified PDLP)
     beta_sufficient: float = 0.2
     beta_necessary: float = 0.8
@@ -874,15 +975,26 @@ class CompiledLPSolver:
     """
 
     def __init__(self, lp: LP, opts: Optional[PDHGOptions] = None):
+        import time as _time
+        _t = _time.perf_counter
+        _phases: dict[str, float] = {}
+        t0 = _t()
         _disable_cache_if_cpu()
         self.opts = opts or PDHGOptions()
         self.lp = lp
         dtype = self.opts.dtype
         d_r, d_c = ruiz_scaling(lp.K, self.opts.ruiz_iters)
+        _phases["ruiz_s"] = _t() - t0
+        t0 = _t()
         Kh_sp = lp.K.multiply(d_r[:, None]).multiply(d_c[None, :]).tocsr()
-        self.op = make_op(Kh_sp, self.opts.dense_bytes_limit, dtype)
-        self.dr = jnp.asarray(d_r, dtype)
-        self.dc = jnp.asarray(d_c, dtype)
+        # build the op with HOST-resident leaves; one batched device_put
+        # below ships the whole pytree in a single transfer (per-array
+        # puts pay a tunnel round-trip each on remote backends — ~1.3 s
+        # of the r4 precondition time at the year-LP shapes)
+        op_host = make_op(Kh_sp, self.opts.dense_bytes_limit, dtype,
+                          put=_hcast)
+        _phases["op_build_s"] = _t() - t0
+        t0 = _t()
         # power iteration for ||Kh||_2 on the HOST (scipy, f64): the
         # matvec chain is O(nnz * power_iters) ≈ milliseconds even at the
         # 420k-variable year LP, while the former on-device scan paid a
@@ -896,8 +1008,17 @@ class CompiledLPSolver:
             sigma_sq = float(np.linalg.norm(w))
             v = w / max(sigma_sq, 1e-30)
         sigma_max = float(np.sqrt(sigma_sq))
-        self.eta = jnp.asarray(self.opts.step_size_safety / max(sigma_max, 1e-12), dtype)
+        eta_host = _hcast(np.float64(
+            self.opts.step_size_safety / max(sigma_max, 1e-12)), dtype)
+        _phases["power_iter_s"] = _t() - t0
+        t0 = _t()
+        self.op, self.dr, self.dc, self.eta = jax.block_until_ready(
+            jax.device_put((op_host, _hcast(d_r, dtype),
+                            _hcast(d_c, dtype), eta_host)))
         self._make_jits()
+        _phases["transfer_s"] = _t() - t0
+        self.precondition_breakdown = {
+            k: round(v, 4) for k, v in _phases.items()}
 
     def _make_jits(self) -> None:
         lp = self.lp
@@ -921,7 +1042,21 @@ class CompiledLPSolver:
         q = lp.q if q is None else q
         l = lp.l if l is None else l
         u = lp.u if u is None else u
-        return (jnp.asarray(c), jnp.asarray(q), jnp.asarray(l), jnp.asarray(u))
+        # host inputs: cast with numpy + ONE batched device_put per call
+        # (jnp.asarray of an f64 numpy array canonicalizes through a
+        # device convert on some paths — a cold-compile hazard on remote
+        # backends, see _dput).  Applied PER argument so a mixed call
+        # (device c, host q/l/u defaults — the normal fan-out shape)
+        # still keeps every host array off the convert path.
+        arrs = [c, q, l, u]
+        host_idx = [i for i, a in enumerate(arrs)
+                    if not isinstance(a, jax.Array)]
+        if host_idx:
+            put = jax.device_put(tuple(
+                _hcast(arrs[i], self.opts.dtype) for i in host_idx))
+            for i, v in zip(host_idx, put):
+                arrs[i] = v
+        return tuple(jnp.asarray(a) for a in arrs)
 
     def solve(self, c=None, q=None, l=None, u=None) -> PDHGResult:
         # the build-time presolve clamp (LPBuilder.build) tightened 'ge'
@@ -1057,10 +1192,10 @@ class CompiledLPSolver:
                         | np.asarray(cur_state.infeasible))
                 sel = np.nonzero(act)[0]
                 pad = np.resize(sel, bucket)   # pad by repeating survivors
-                full_state = _scatter_state(full_state, cur_state, idx)
+                full_state, cur, cur_state = _compact_step(
+                    full_state, cur_state, cur,
+                    jnp.asarray(idx), jnp.asarray(pad))
                 idx = idx[pad]
-                cur = tuple(a[pad] for a in cur)
-                cur_state = jax.tree.map(lambda a: a[pad], cur_state)
         full_state = _scatter_state(full_state, cur_state, idx)
         full_state = self._cpu_rescue(full_state, c, q, l, u, total)
         return fin(*args, full_state)
@@ -1116,11 +1251,28 @@ class CompiledLPSolver:
         return c, q, l, u
 
 
-def _scatter_state(full: "_State", sub: "_State", idx: np.ndarray) -> "_State":
+@jax.jit
+def _scatter_state(full: "_State", sub: "_State", idx) -> "_State":
     """Write sub-batch state rows back into the full-batch state.
     ``idx`` may repeat positions (bucket padding); duplicates carry
-    identical rows, so later writes are no-ops."""
+    identical rows, so later writes are no-ops.  Jitted: unjitted, the
+    tree.map issued one device op per state field — ~17 dispatches at
+    ~10 ms tunnel latency each on remote backends."""
     return jax.tree.map(lambda f, s: f.at[idx].set(s), full, sub)
+
+
+@jax.jit
+def _compact_step(full: "_State", sub: "_State", cur, idx, pad):
+    """One fused dispatch per compaction event: scatter the sub-batch
+    back into the full state at ``idx``, then gather the survivor rows
+    ``pad`` into the next (smaller) sub-batch.  Issued as ~21 separate
+    device ops this cost ~0.4 s per event over a remote-compile tunnel —
+    more than the fused chunks it saved at product batch sizes
+    (VERDICT r5 #1)."""
+    full2 = jax.tree.map(lambda f, s: f.at[idx].set(s), full, sub)
+    cur2 = tuple(a[pad] for a in cur)
+    sub2 = jax.tree.map(lambda a: a[pad], sub)
+    return full2, cur2, sub2
 
 
 @jax.jit

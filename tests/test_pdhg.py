@@ -371,7 +371,7 @@ class TestBandedOp:
         Z = sp.hstack([D, -0.8 * sp.eye(T), 0.5 * sp.eye(T)])
         self._check(Z.tocsr(), "BandedOp")
 
-    def test_aggregation_rows_ride_residual_ell(self):
+    def test_aggregation_rows_ride_wide_pair(self):
         import scipy.sparse as sp
         rng = np.random.default_rng(3)
         T = 2000
@@ -384,7 +384,28 @@ class TestBandedOp:
         op_k = sp.vstack([Z, agg]).tocsr()
         from dervet_tpu.ops.pdhg import make_op
         op = make_op(op_k, dense_bytes_limit=0)
-        assert op.ell is not None       # residual engaged
+        # r5: few-row aggregation residuals ride the low-rank wide pair
+        # (kernel-eligible), not an ELL residual
+        assert op.ell is None and op.wide_w is not None
+        assert op.wide_w.shape == (1, 3 * T)
+        self._check(op_k, "BandedOp")
+
+    def test_many_residual_rows_ride_residual_ell(self):
+        import scipy.sparse as sp
+        rng = np.random.default_rng(4)
+        T = 2000
+        D = sp.diags([np.ones(T), -0.9 * np.ones(T - 1)], [0, -1])
+        Z = sp.hstack([D, -0.8 * sp.eye(T), 0.5 * sp.eye(T)])
+        from dervet_tpu.ops.pdhg import WIDE_MAX_ROWS, make_op
+        n_many = WIDE_MAX_ROWS + 16
+        many = sp.coo_matrix(
+            (np.ones(2 * n_many),
+             (np.repeat(np.arange(n_many), 2),
+              rng.integers(0, 3 * T, 2 * n_many))),
+            shape=(n_many, 3 * T))
+        op_k = sp.vstack([Z, many]).tocsr()
+        op = make_op(op_k, dense_bytes_limit=0)
+        assert op.ell is not None and op.wide_w is None
         self._check(op_k, "BandedOp")
 
     def test_unstructured_falls_back_to_ell(self):
@@ -434,15 +455,31 @@ def test_banded_kernel_support_gate():
     assert isinstance(op, BandedOp) and op.ell is None
     # gate passes on a TPU backend spec (platform-independent args)
     assert pallas_chunk.supports(op, jnp.float32, backend="tpu")
-    # a residual ELL part disqualifies the kernel
+    # a few aggregation rows ride the low-rank wide-row pair and KEEP
+    # kernel support (r5: daily-cycle rows disqualified the kernel on
+    # every real monthly window when they rode a residual ELL)
     rng = np.random.default_rng(0)
     agg = sp.coo_matrix(
         (np.ones(400), (np.zeros(400, int),
                         rng.choice(3 * T, 400, replace=False))),
         shape=(1, 3 * T))
     op2 = make_op(sp.vstack([Zs, agg]).tocsr(), dense_bytes_limit=0)
-    assert isinstance(op2, BandedOp) and op2.ell is not None
-    assert not pallas_chunk.supports(op2, jnp.float32, backend="tpu")
+    assert isinstance(op2, BandedOp) and op2.ell is None
+    assert op2.wide_w is not None and op2.wide_w.shape[0] == 1
+    assert pallas_chunk.supports(op2, jnp.float32, backend="tpu")
+    # beyond WIDE_MAX_ROWS distinct residual rows the fallback is still a
+    # residual ELL, which disqualifies the kernel
+    from dervet_tpu.ops.pdhg import WIDE_MAX_ROWS
+    n_many = WIDE_MAX_ROWS + 16
+    many = sp.coo_matrix(
+        (np.ones(2 * n_many),
+         (np.repeat(np.arange(n_many), 2),
+          rng.integers(0, 3 * T, 2 * n_many))),
+        shape=(n_many, 3 * T))
+    op3 = make_op(sp.vstack([Zs, many]).tocsr(), dense_bytes_limit=0)
+    assert isinstance(op3, BandedOp) and op3.ell is not None
+    assert op3.wide_w is None
+    assert not pallas_chunk.supports(op3, jnp.float32, backend="tpu")
     # the kill switch is overridable for compile-failure handlers
     pallas_chunk.RUNTIME_DISABLED = True
     try:
