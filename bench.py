@@ -263,8 +263,9 @@ def sensitivity_leg() -> dict:
         # second identical sweep reuses them via the in-process +
         # persistent caches and shows the steady-state product rate
         t0 = time.time()
-        DERVET(mp, base_path="/root/reference").solve(backend="jax")
+        res_w = DERVET(mp, base_path="/root/reference").solve(backend="jax")
         t_jax_warm = time.time() - t0
+        phases = dict(getattr(res_w, "phase_seconds", {}) or {})
         t0 = time.time()
         res_c = DERVET(mp, base_path="/root/reference").solve(backend="cpu")
         t_cpu = time.time() - t0
@@ -277,13 +278,15 @@ def sensitivity_leg() -> dict:
         worst = max(worst, abs(nj - nc) / max(1.0, abs(nc)))
     ok = worst < 1e-2
     log(f"bench[sensitivity]: {n_cases} cases x 12 windows — jax cold "
-        f"{t_jax:.1f}s / warm {t_jax_warm:.1f}s vs serial cpu "
-        f"{t_cpu:.1f}s ({t_cpu / t_jax_warm:.2f}x warm); worst per-case "
-        f"NPV rel err {worst:.2e} (gate 1e-2): {'OK' if ok else 'FAIL'}")
+        f"{t_jax:.1f}s / warm {t_jax_warm:.1f}s (phases {phases}) vs "
+        f"serial cpu {t_cpu:.1f}s ({t_cpu / t_jax_warm:.2f}x warm); worst "
+        f"per-case NPV rel err {worst:.2e} (gate 1e-2): "
+        f"{'OK' if ok else 'FAIL'}")
     if not ok:
         raise SystemExit(4)
     return {"cases": n_cases, "jax_cold_s": round(t_jax, 2),
             "jax_warm_s": round(t_jax_warm, 2),
+            "warm_phases": phases,
             "cpu_s": round(t_cpu, 2),
             "speedup_warm": round(t_cpu / t_jax_warm, 2),
             "worst_npv_rel_err": float(f"{worst:.3e}")}

@@ -281,7 +281,17 @@ class Datasets:
 def load_time_series(path: Path, dt_hours: float) -> pd.DataFrame:
     df = pd.read_csv(path)
     dt_col = df.columns[0]
-    idx = pd.to_datetime(df[dt_col], format="mixed", dayfirst=False)
+    import warnings
+    try:
+        # vectorized single-format parse (pandas infers from row 0) —
+        # format="mixed" falls back to per-element dateutil parsing,
+        # ~1.9 s for a year of hourly stamps (profiled r5).  The
+        # could-not-infer warning is silenced: falling back IS the plan.
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            idx = pd.to_datetime(df[dt_col], dayfirst=False)
+    except (ValueError, TypeError):
+        idx = pd.to_datetime(df[dt_col], format="mixed", dayfirst=False)
     # the reference's time series are hour-ENDING; convert to hour-beginning
     df = df.drop(columns=[dt_col])
     df.index = idx - pd.Timedelta(hours=dt_hours)

@@ -67,6 +67,14 @@ class LP:
     # on/off formulation solves on the exact CPU MILP backend — the PDHG
     # TPU kernel is continuous-only (SURVEY §7 hard part #5)
     integrality: Optional[np.ndarray] = None
+    # structure fingerprint + cached presolve-clamp operators, set by
+    # build(): lets ``LPBuilder.build_data`` verify that a sibling
+    # sensitivity case shares this LP's constraint matrix byte-for-byte
+    # and then assemble only c/q/l/u against the shared K (VERDICT r5 #1
+    # — the K assembly is ~2/3 of a window build)
+    structure_digest: Optional[bytes] = None
+    clamp_pos: Optional[sp.csr_matrix] = None
+    clamp_neg: Optional[sp.csr_matrix] = None
 
     def objective_breakdown(self, x: np.ndarray) -> Dict[str, float]:
         """Per-label objective contributions for a solution vector."""
@@ -212,7 +220,41 @@ class LPBuilder:
         idx = np.arange(ref.size)
         return idx + row0, idx + ref.start, np.full(ref.size, float(coef))
 
-    def build(self) -> LP:
+    def _structure_digest(self) -> bytes:
+        """Fingerprint of everything that determines K / n_eq / the
+        variable layout: var names+sizes+binaries in order, row groups in
+        emission order with sense and row counts, and every coefficient's
+        exact bytes.  Two builders with equal digests assemble
+        byte-identical constraint matrices, so ``build_data`` may reuse a
+        template's K.  Bounds, costs, and rhs are deliberately NOT
+        covered — they are the per-case data."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for ref in self._vars:
+            h.update(f"v|{ref.name}|{ref.size}|"
+                     f"{ref.name in self._binary}|".encode())
+        for sense_tag, block_list in (("eq", self._eq_rows),
+                                      ("ge", self._ge_rows)):
+            for name, k, terms, _rhs in block_list:
+                h.update(f"r|{sense_tag}|{name}|{k}|".encode())
+                for ref, coef in terms:
+                    h.update(f"t|{ref.name}|".encode())
+                    if sp.issparse(coef):
+                        csr = coef.tocsr()
+                        h.update(csr.indptr.tobytes())
+                        h.update(csr.indices.tobytes())
+                        h.update(csr.data.tobytes())
+                    else:
+                        a = np.ascontiguousarray(
+                            np.asarray(coef, np.float64))
+                        h.update(str(a.shape).encode())
+                        h.update(a.tobytes())
+        return h.digest()
+
+    def _data_vectors(self):
+        """(c, cost_groups, c0-map, l, u) — the per-case data that
+        ``build`` and ``build_data`` assemble identically."""
         n = self._n
         c = np.zeros(n)
         cost_groups: Dict[str, Tuple[np.ndarray, float]] = {}
@@ -231,6 +273,45 @@ class LPBuilder:
              if self._vars else np.zeros(0))
         u = (np.concatenate([self._ub[v.name] for v in self._vars])
              if self._vars else np.zeros(0))
+        return c, cost_groups, l, u
+
+    def build_data(self, template: Optional[LP]) -> LP:
+        """Assemble an LP that shares ``template``'s constraint matrix,
+        computing only the per-case data vectors (c, q, l, u).
+
+        Safe by verification, not assumption: the structure digest covers
+        every coefficient byte, so a sensitivity parameter that DOES
+        enter K (an rte sweep, a DR event window) mismatches and falls
+        back to a full ``build()`` transparently.  With a match, the
+        ~2/3 of window-assembly time spent on COO/CSR construction and
+        presolve operator extraction is skipped (VERDICT r5 #1)."""
+        dig = self._structure_digest()
+        if template is None or template.structure_digest != dig \
+                or template.n != self._n:
+            return self.build(_digest=dig)
+        c, cost_groups, l, u = self._data_vectors()
+        q_parts = [rhs for block_list in (self._eq_rows, self._ge_rows)
+                   for _, _, _, rhs in block_list]
+        q = np.concatenate(q_parts) if q_parts else np.zeros(0)
+        n_eq = template.n_eq
+        if template.m > n_eq and template.clamp_pos is not None:
+            act_min = np.asarray(template.clamp_pos @ l
+                                 + template.clamp_neg @ u).ravel()
+            qi = q[n_eq:]
+            with np.errstate(invalid="ignore"):
+                q[n_eq:] = np.where(np.isfinite(act_min),
+                                    np.maximum(qi, act_min), qi)
+        return LP(c=c, K=template.K, q=q, n_eq=n_eq, l=l, u=u,
+                  var_refs=template.var_refs,
+                  row_groups=template.row_groups, c0=self._c0,
+                  cost_groups=cost_groups,
+                  integrality=template.integrality,
+                  structure_digest=dig, clamp_pos=template.clamp_pos,
+                  clamp_neg=template.clamp_neg)
+
+    def build(self, _digest: Optional[bytes] = None) -> LP:
+        n = self._n
+        c, cost_groups, l, u = self._data_vectors()
 
         rows_i, cols_i, vals_i = [], [], []
         q_parts, groups = [], {}
@@ -271,11 +352,12 @@ class LPBuilder:
         # 'ge' row, min_x K_row @ x over the box [l, u] is
         # sum_j min(K_ij*l_j, K_ij*u_j); if q_i is below that, the row can
         # never bind and raising q_i to the bound is exact.
+        clamp_pos = clamp_neg = None
         if m > n_eq:
             Kge = K[n_eq:]
-            pos = Kge.multiply(Kge > 0)
-            neg = Kge.multiply(Kge < 0)
-            act_min = np.asarray(pos @ l + neg @ u).ravel()
+            clamp_pos = Kge.multiply(Kge > 0).tocsr()
+            clamp_neg = Kge.multiply(Kge < 0).tocsr()
+            act_min = np.asarray(clamp_pos @ l + clamp_neg @ u).ravel()
             qi = q[n_eq:]
             with np.errstate(invalid="ignore"):
                 q[n_eq:] = np.where(np.isfinite(act_min),
@@ -287,4 +369,7 @@ class LPBuilder:
                 integrality[self._by_name[name].sl] = 1
         return LP(c=c, K=K, q=q, n_eq=n_eq, l=l, u=u,
                   var_refs=dict(self._by_name), row_groups=groups, c0=self._c0,
-                  cost_groups=cost_groups, integrality=integrality)
+                  cost_groups=cost_groups, integrality=integrality,
+                  structure_digest=(_digest if _digest is not None
+                                    else self._structure_digest()),
+                  clamp_pos=clamp_pos, clamp_neg=clamp_neg)

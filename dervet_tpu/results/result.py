@@ -119,10 +119,25 @@ class CaseResult:
         idx = ts.index
 
         def pivot(series: pd.Series) -> pd.DataFrame:
-            df = pd.DataFrame({"hour": idx.hour + 1,
-                               "day": idx.normalize().date,
-                               "val": series.to_numpy()})
-            return df.pivot_table(index="hour", columns="day", values="val")
+            # hour x day mean pivot via one bincount pass — pivot_table
+            # cost ~12 ms per map, ~2 maps per case, the largest single
+            # post-processing item of a 128-case sweep (VERDICT r5 #1)
+            codes, uniq = pd.factorize(idx.normalize())
+            hours = np.asarray(idx.hour)
+            nd = len(uniq)
+            key = hours * nd + codes
+            vals_in = series.to_numpy(dtype=np.float64)
+            valid = ~np.isnan(vals_in)       # pivot_table mean skips NaN
+            tot = np.bincount(key[valid], weights=vals_in[valid],
+                              minlength=24 * nd)
+            cnt = np.bincount(key[valid], minlength=24 * nd)
+            with np.errstate(invalid="ignore"):
+                vals = (tot / np.where(cnt, cnt, np.nan)).reshape(24, nd)
+            present = cnt.reshape(24, nd).sum(axis=1) > 0
+            return pd.DataFrame(
+                vals[present],
+                index=pd.Index(np.arange(1, 25)[present], name="hour"),
+                columns=pd.Index([d.date() for d in uniq], name="day"))
 
         if "Total Load (kW)" in ts.columns:
             load = ts["Total Load (kW)"]

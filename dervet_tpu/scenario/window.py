@@ -34,25 +34,24 @@ def build_optimization_levels(index: pd.DatetimeIndex, n, dt: float) -> pd.Serie
     if isinstance(n, str):
         key = n.strip().lower()
         if key == "year":
-            labels = index.year.astype(np.int64)
+            labels = index.year.to_numpy(np.int64)
         elif key == "month":
-            labels = index.year.astype(np.int64) * 100 + index.month.astype(np.int64)
+            labels = (index.year.to_numpy(np.int64) * 100
+                      + index.month.to_numpy(np.int64))
         else:
             raise TimeseriesDataError(f"unrecognized optimization window n={n!r}")
-        codes = pd.Series(labels, index=index)
     else:
         steps = int(round(float(n) / dt))
         if steps <= 0:
             raise TimeseriesDataError(f"optimization window n={n} must be positive")
-        codes = pd.Series(0, index=index, dtype=np.int64)
-        for yr in sorted(set(index.year)):
-            mask = index.year == yr
+        labels = np.zeros(len(index), np.int64)
+        years = index.year.to_numpy(np.int64)
+        for yr in np.unique(years):
+            mask = years == yr
             within = np.arange(int(mask.sum())) // steps
-            codes.loc[mask] = yr * 100_000 + within
-    # renumber to consecutive ints in time order
-    uniq = codes.unique()
-    remap = {lab: i for i, lab in enumerate(uniq)}
-    return codes.map(remap)
+            labels[mask] = yr * 100_000 + within
+    # renumber to consecutive ints in order of appearance (= time order)
+    return pd.Series(pd.factorize(labels)[0], index=index)
 
 
 def grab_column(ts: pd.DataFrame, name: str, der_id: str = "",
@@ -120,10 +119,22 @@ class WindowContext:
 
 def make_windows(index: pd.DatetimeIndex, ts: pd.DataFrame, monthly,
                  n, dt: float) -> List[WindowContext]:
-    levels = build_optimization_levels(index, n, dt)
+    levels = build_optimization_levels(index, n, dt).to_numpy()
     out = []
-    for label in levels.unique():
-        mask = (levels == label).to_numpy()
+    if np.all(np.diff(levels) >= 0):
+        # labels are consecutive in time (the normal ascending-index
+        # case): windows are contiguous slices, and positional slicing
+        # skips the per-window label-indexer lookups that cost ~30 ms
+        # per sensitivity case (×128 cases, VERDICT r5 #1)
+        starts = np.concatenate(
+            ([0], np.nonzero(np.diff(levels))[0] + 1, [len(levels)]))
+        for i in range(len(starts) - 1):
+            a, b = int(starts[i]), int(starts[i + 1])
+            out.append(WindowContext(label=int(levels[a]), index=index[a:b],
+                                     ts=ts.iloc[a:b], monthly=monthly, dt=dt))
+        return out
+    for label in pd.unique(levels):
+        mask = levels == label
         sub = index[mask]
         out.append(WindowContext(label=int(label), index=sub, ts=ts.loc[sub],
                                  monthly=monthly, dt=dt))
