@@ -899,24 +899,32 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int, axis=None):
 # Failure signatures of the fused Pallas chunk kernel's COMPILE step — not
 # generic device errors.  'scoped vmem'/'vmem limit'/'memory space vmem'
 # are XLA/Mosaic compile-time VMEM rejections ('memory space hbm' runtime
-# OOM deliberately does NOT match); 'tpu_compile_helper'/'remote_compile'
-# is the remote-compile backend's helper subprocess dying on an oversized
-# kernel (observed as "INTERNAL: http://…/remote_compile: HTTP 500:
+# OOM deliberately does NOT match); 'tpu_compile_helper' is the
+# remote-compile backend's helper subprocess dying on an oversized kernel
+# (observed as "INTERNAL: http://…/remote_compile: HTTP 500:
 # tpu_compile_helper subprocess exit code 1").  A bare 'vmem' substring is
 # deliberately NOT enough: runtime resource exhaustion from an oversized
 # batch must propagate, not mask itself as a slow scan retry (ADVICE r3).
-# Callers must ALSO check the kernel was actually in the failed program
-# (supports()) — on remote-compile backends every compile error carries
-# the remote_compile URL.
+# The bare 'remote_compile' URL is NOT in this tuple: it appears in EVERY
+# error such backends raise, so is_pallas_compile_failure accepts it only
+# together with an HTTP 5xx marker (ADVICE r4).  Callers must ALSO check
+# the kernel was actually in the failed program (supports()).
 _PALLAS_COMPILE_SIGNATURES = (
     "scoped vmem", "vmem limit", "memory space vmem", "mosaic",
-    "tpu_compile_helper", "remote_compile",
+    "tpu_compile_helper",
 )
 
 
 def is_pallas_compile_failure(e: Exception) -> bool:
     msg = str(e).lower()
-    return any(sig in msg for sig in _PALLAS_COMPILE_SIGNATURES)
+    if any(sig in msg for sig in _PALLAS_COMPILE_SIGNATURES):
+        return True
+    # every error from a remote-compile backend embeds the remote_compile
+    # URL, so the bare substring is NOT evidence of a compile failure — a
+    # runtime HBM OOM whose message carries the endpoint would otherwise
+    # disable the kernel process-wide and silently retry on the scan path
+    # (ADVICE r4).  Require the compile helper's HTTP failure alongside.
+    return "remote_compile" in msg and "http 5" in msg
 
 
 def pallas_compiler_options(opts: "PDHGOptions", op=None):
@@ -1019,6 +1027,16 @@ class CompiledLPSolver:
         _phases["transfer_s"] = _t() - t0
         self.precondition_breakdown = {
             k: round(v, 4) for k, v in _phases.items()}
+        # serializes concurrent solve() calls on THIS solver: the dispatch
+        # pipeline may route two same-structure subgroups to one cached
+        # solver from different workers, and _drive's compile-failure
+        # fallback mutates self.opts and rebuilds the jits (ADVICE r4).
+        # Scope is the WHOLE solve on purpose: same-solver solves share
+        # one accelerator anyway (no throughput to win by overlapping),
+        # and a narrow except-only critical section would still let a
+        # second solve trace against half-rebuilt jits.
+        import threading
+        self._solve_lock = threading.Lock()
 
     def _make_jits(self) -> None:
         lp = self.lp
@@ -1098,23 +1116,26 @@ class CompiledLPSolver:
         """Fallback wrapper: if the fused Pallas chunk cannot compile on
         this backend, disable it process-wide and retry on the XLA scan
         path."""
-        try:
-            return self._drive_inner(c, q, l, u, batched)
-        except Exception as e:
-            from . import pallas_chunk
-            # ignore_runtime_disabled: the failing program was TRACED
-            # before a concurrent thread may have flipped the kill switch
-            kernel_in_play = (self.opts.pallas_chunk and batched
-                              and pallas_chunk.supports(
-                                  self.op, self.opts.dtype,
-                                  self.opts.precision,
-                                  ignore_runtime_disabled=True))
-            if not (kernel_in_play and is_pallas_compile_failure(e)):
-                raise
-            disable_pallas_runtime(e)
-            self.opts = dataclasses.replace(self.opts, pallas_chunk=False)
-            self._make_jits()
-            return self._drive_inner(c, q, l, u, batched)
+        with self._solve_lock:   # one in-flight solve per solver (ADVICE r4)
+            try:
+                return self._drive_inner(c, q, l, u, batched)
+            except Exception as e:
+                from . import pallas_chunk
+                # ignore_runtime_disabled: the failing program was TRACED
+                # before a concurrent thread may have flipped the kill
+                # switch
+                kernel_in_play = (self.opts.pallas_chunk and batched
+                                  and pallas_chunk.supports(
+                                      self.op, self.opts.dtype,
+                                      self.opts.precision,
+                                      ignore_runtime_disabled=True))
+                if not (kernel_in_play and is_pallas_compile_failure(e)):
+                    raise
+                disable_pallas_runtime(e)
+                self.opts = dataclasses.replace(self.opts,
+                                                pallas_chunk=False)
+                self._make_jits()
+                return self._drive_inner(c, q, l, u, batched)
 
     def _drive_inner(self, c, q, l, u, batched: bool) -> PDHGResult:
         """Host-chunked driver: bounded device calls until every instance

@@ -71,21 +71,27 @@ def solve_batch_sharded(solver: CompiledLPSolver, mesh: Mesh,
     import dataclasses
 
     from ..ops.pdhg import disable_pallas_runtime, is_pallas_compile_failure
-    try:
-        return _solve_batch_sharded_inner(solver, mesh, c, q, l, u)
-    except Exception as e:
-        from ..ops import pallas_chunk
-        kernel_in_play = (solver.opts.pallas_chunk
-                          and pallas_chunk.supports(
-                              solver.op, solver.opts.dtype,
-                              solver.opts.precision,
-                              ignore_runtime_disabled=True))
-        if not (kernel_in_play and is_pallas_compile_failure(e)):
-            raise
-        disable_pallas_runtime(e)
-        solver.opts = dataclasses.replace(solver.opts, pallas_chunk=False)
-        solver._make_jits()
-        return _solve_batch_sharded_inner(solver, mesh, c, q, l, u)
+    # same per-solver serialization as CompiledLPSolver._drive: the
+    # fallback below mutates solver.opts and rebuilds the jits, which
+    # must not interleave with another thread's solve on this solver
+    # (ADVICE r4 / review r5)
+    with solver._solve_lock:
+        try:
+            return _solve_batch_sharded_inner(solver, mesh, c, q, l, u)
+        except Exception as e:
+            from ..ops import pallas_chunk
+            kernel_in_play = (solver.opts.pallas_chunk
+                              and pallas_chunk.supports(
+                                  solver.op, solver.opts.dtype,
+                                  solver.opts.precision,
+                                  ignore_runtime_disabled=True))
+            if not (kernel_in_play and is_pallas_compile_failure(e)):
+                raise
+            disable_pallas_runtime(e)
+            solver.opts = dataclasses.replace(solver.opts,
+                                              pallas_chunk=False)
+            solver._make_jits()
+            return _solve_batch_sharded_inner(solver, mesh, c, q, l, u)
 
 
 def _solve_batch_sharded_inner(solver: CompiledLPSolver, mesh: Mesh,

@@ -1014,8 +1014,10 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
         # observable for the solver cache: a degradation year must show
         # builds == distinct structures (typically 3 month lengths), not
         # builds == window steps
-        s.solve_metadata["solver_builds"] = cache.builds
-        s.solve_metadata["solver_cache_hits"] = cache.hits
+        # dispatch_ prefix: these are DISPATCH-GLOBAL totals recorded on
+        # every case of a sweep, not per-case counts (ADVICE r4)
+        s.solve_metadata["dispatch_solver_builds"] = cache.builds
+        s.solve_metadata["dispatch_solver_hits"] = cache.hits
         s.solve_metadata["dispatch_assembly_s"] = round(
             phase_acc["assembly_s"], 3)
         s.solve_metadata["dispatch_solve_s"] = round(phase_acc["solve_s"], 3)

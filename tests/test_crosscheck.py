@@ -1,0 +1,25 @@
+"""Independent-formulation cross-check (VERDICT r5 #5).
+
+For stream families with no reference golden (FR/SR/NSR/LF, DR, User —
+their executable spec lives in the missing StorageVET layer), every
+window's LP is re-assembled by a SECOND, independent stack
+(``scripts/crosscheck_formulation.py``: flat-index scipy COO + linprog,
+no LPBuilder) and the optimal window objectives must agree.  Two
+equivalent LPs share their optimum even at degenerate argmins, so the
+gate is tight (1e-5 relative; measured <=6e-11 across all families).
+"""
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "scripts"))
+
+from crosscheck_formulation import CASES, crosscheck_case  # noqa: E402
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", sorted(CASES))
+def test_independent_formulation_agrees(family):
+    worst = crosscheck_case(family)
+    assert worst < 1e-5, (family, worst)

@@ -169,6 +169,6 @@ def test_solver_cache_one_precondition_per_structure():
     meta = res.instances[0].scenario.solve_metadata
     # a year of monthly windows has exactly 3 structures (28/30/31 days)
     assert meta["n_windows"] == 12
-    assert meta["solver_builds"] == 3, meta
-    assert meta["solver_cache_hits"] == 9, meta
+    assert meta["dispatch_solver_builds"] == 3, meta
+    assert meta["dispatch_solver_hits"] == 9, meta
     assert len(builds) == 3, builds

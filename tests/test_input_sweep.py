@@ -92,9 +92,32 @@ DEGENERATE_SPLIT = {
 }
 
 
+# Default-suite parity slice (VERDICT r5 #6): small inputs spanning DA,
+# FR, deferral, retail+DCM, and degradation run cpu-vs-jax NPV/proforma
+# parity WITHOUT --runslow, so a solver-numerics regression fails the
+# default local gate.  The full feature-matrix sweep below stays slow.
+FAST_PARITY_SLICE = [
+    "000-DA_battery_month.csv",
+    "001-DA_FR_battery_month.csv",
+    "003-DA_Deferral_battery_month.csv",
+    "004-fixed_size_battery_retailets_dcm.csv",
+    "010-degradation_test.csv",
+]
+
+
+@pytest.mark.parametrize("name", FAST_PARITY_SLICE)
+def test_backend_parity_default_slice(name):
+    _check_backend_parity(name)
+
+
 @pytest.mark.slow
-@pytest.mark.parametrize("name", runnable_csvs())
+@pytest.mark.parametrize(
+    "name", [n for n in runnable_csvs() if n not in FAST_PARITY_SLICE])
 def test_backend_parity_cpu_vs_jax(name):
+    _check_backend_parity(name)
+
+
+def _check_backend_parity(name):
     import numpy as np
 
     path = MP / name

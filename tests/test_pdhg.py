@@ -560,11 +560,20 @@ def test_pallas_compile_failure_classifier():
         "RESOURCE_EXHAUSTED: scoped vmem limit exceeded",
         "requested vmem limit 104857600 exceeds device maximum",
     ]
+    caught.append(
+        # generic remote-compile HTTP failure without the helper line
+        "INTERNAL: http://127.0.0.1:8103/remote_compile: HTTP 503: "
+        "compile backend unavailable")
     passed_through = [
         "RESOURCE_EXHAUSTED: Out of memory allocating 2.1G in vmem/hbm",
         "RESOURCE_EXHAUSTED: out of HBM allocating batch buffers",
         "FAILED_PRECONDITION: device halted",
         "some unrelated ValueError",
+        # a RUNTIME error that merely embeds the remote-compile endpoint
+        # must propagate — the bare URL is on every error from such
+        # backends (ADVICE r4)
+        "RESOURCE_EXHAUSTED: out of memory while executing program "
+        "fetched via http://127.0.0.1:8103/remote_compile",
     ]
     for msg in caught:
         assert is_pallas_compile_failure(Exception(msg)), msg
