@@ -66,9 +66,24 @@ class MarketService(ValueStream):
     def _use_ts_bounds(self, direction: str) -> bool:
         return False
 
+    #: deterministic tie-break rank: when two services price capacity
+    #: identically the optimum is a face (HiGHS returns a vertex, PDHG a
+    #: face point, and per-column revenue attribution diverges between
+    #: backends — the r4 DEGENERATE_SPLIT carve-out).  A relative tilt of
+    #: TIEBREAK_EPS x rank on each service's OPTIMIZATION price makes the
+    #: split unique while perturbing the objective by <= 4e-4 relative;
+    #: reporting (proforma/NPV) always uses the untilted price.  1e-3,
+    #: not 1e-4: the tilt gradient must dominate PDHG's convergence
+    #: tolerance (eps_rel 1e-4) for the iterate to actually land on the
+    #: preferred vertex — at 1e-4 the split still wandered ~1.5% of a
+    #: column's scale (input 008, r5).
+    TIEBREAK_RANK = {"FR": 1, "SR": 2, "NSR": 3, "LF": 4}
+    TIEBREAK_EPS = 1e-3
+
     def build(self, b: LPBuilder, ctx: WindowContext, ders) -> None:
         scale = ctx.dt * ctx.annuity_scalar
         da_price = ctx.col(DA_PRICE_COL)
+        tilt = 1.0 - self.TIEBREAK_EPS * self.TIEBREAK_RANK.get(self.tag, 0)
         refs = {}
         for direction, price_col, stem, _ in self.directions:
             price = ctx.col(price_col)
@@ -83,8 +98,13 @@ class MarketService(ValueStream):
                     lb = np.maximum(lo, 0.0)
             ref = b.var(f"{self.tag}/{direction}", ctx.T, lb=lb, ub=ub)
             refs[direction] = ref
-            # capacity revenue (negative cost)
+            # capacity revenue (negative cost).  The labeled (reported)
+            # vector stays UNTILTED — objective_values must not be
+            # biased per stream — while the tilt rides as a separate
+            # unlabeled cost so only the optimizer sees it.
             b.add_cost(ref, -price * scale, label=self.tag)
+            if tilt != 1.0:
+                b.add_cost(ref, price * scale * (1.0 - tilt))
             # expected-throughput energy settlement at DA price: up sells
             # energy (revenue), down absorbs energy (cost); k is kWh per
             # kW-hr of award so the single dt in `scale` converts the

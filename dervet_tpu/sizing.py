@@ -71,23 +71,44 @@ def sizing_sweep(case: CaseParams, kw_grid: Sequence[float],
 
     # one scenario per candidate (host-side assembly); window STRUCTURE is
     # identical across candidates, so LPs group by window length and the
-    # candidate axis concatenates into the solver's batch dimension
+    # candidate axis concatenates into the solver's batch dimension.
+    # Candidates differ only in bounds/rhs/costs, so after the first
+    # candidate builds a window label, its siblings assemble DATA-ONLY
+    # against the shared K (digest-verified; VERDICT r5 #7)
     scens = [_candidate_scenario(case, der_tag, der_id, kw, kwh)
              for kw, kwh in candidates]
     groups: Dict[int, List[Tuple[int, object]]] = {}
+    templates: Dict[int, object] = {}
     for ci, s in enumerate(scens):
         if s.poi.is_sizing_optimization:
             raise ParameterError(
                 "sizing_sweep drives FIXED-size candidates; zero ratings "
                 "elsewhere in the case would add size variables")
         for ctx in s.windows:
-            lp = s.build_window_lp(ctx)
+            lp = s.build_window_lp(ctx, template=templates.get(ctx.label))
+            templates.setdefault(ctx.label, lp)
             groups.setdefault(ctx.T, []).append((ci, lp))
 
     n_cand = len(candidates)
     op_value = np.zeros(n_cand)
     all_ok = np.ones(n_cand, bool)
-    for T, entries in sorted(groups.items()):
+    any_lp = next(iter(groups.values()))[0][1]
+    if any_lp.integrality is not None:
+        # the product dispatch path routes binary windows to the exact
+        # CPU MILP; the sweep's batched device path cannot — make the
+        # relaxation explicit instead of silently degrading (also note:
+        # with binary=1 the capacity coefficient enters the on/off rows,
+        # so candidates stop sharing K and lose template reuse)
+        TellUser.warning(
+            "sizing_sweep solves the LP RELAXATION of binary on/off "
+            "windows (scenario binary=1) on the batch axis; set binary=0 "
+            "for the sweep or use the exact continuous-sizing path")
+
+    def solve_group_batch(T, entries):
+        """Returns per-group (objs+c0, ok) aligned with ``entries`` —
+        accumulation into the shared candidate arrays happens on the
+        MAIN thread after join (every candidate has windows in every
+        group, so threaded `op_value[ci] +=` would be a data race)."""
         lps = [lp for _, lp in entries]
         lp0 = lps[0]
         solver = CompiledLPSolver(lp0, solver_opts or PDHGOptions())
@@ -98,11 +119,26 @@ def sizing_sweep(case: CaseParams, kw_grid: Sequence[float],
         res = solver.solve(c=C, q=Q, l=L, u=U)
         objs = np.asarray(res.obj)
         ok = np.asarray(res.converged)
-        for k, (ci, lp) in enumerate(entries):
-            op_value[ci] += float(objs[k]) + lp.c0
-            all_ok[ci] &= bool(ok[k])
         TellUser.debug(f"sizing_sweep: group T={T} solved "
                        f"{len(entries)} window-LPs")
+        return ([float(objs[k]) + lp.c0 for k, (_, lp) in enumerate(entries)],
+                [bool(v) for v in ok])
+
+    # one thread per window-length group: the groups compile DIFFERENT
+    # XLA programs, and compiling them concurrently (compiles release the
+    # GIL) collapses the sweep's cold start — same pattern as bench.py's
+    # warm-up.  Device execution still interleaves safely (per-solver
+    # locks; distinct solvers here)
+    import concurrent.futures as cf
+    items = sorted(groups.items())
+    with cf.ThreadPoolExecutor(max_workers=max(1, len(items))) as pool:
+        futs = [pool.submit(solve_group_batch, T, entries)
+                for T, entries in items]
+        for (T, entries), f in zip(items, futs):
+            vals, oks = f.result()
+            for (ci, _), v, k_ok in zip(entries, vals, oks):
+                op_value[ci] += v
+                all_ok[ci] &= k_ok
 
     rows = []
     for ci, (kw, kwh) in enumerate(candidates):

@@ -72,24 +72,19 @@ def runnable_csvs():
             if n not in EXPECT_ERROR and n not in MISSING_DATA]
 
 
-# Inputs whose OPTIMUM is degenerate across value streams, so per-column
-# proforma attribution is non-unique — HiGHS returns a vertex, PDHG a
-# face point, with window-objective totals and NPV agreeing (verified at
-# triage, r4).  For these, parity is asserted on NPV and on each year's
-# NET proforma row instead of per column.
-DEGENERATE_SPLIT = {
-    # SR and NSR priced identically: reserve-capacity split (and the ICE
-    # energy/reserve allocation feeding DA ETS) is a face of optima;
-    # totals agree to 5e-5
-    "027-DA_FR_SR_NSR_pv_ice_month.csv",
-    # DA energy vs SR reserve marginal-value ties shift ~1.6% of DA ETS
-    # between the two streams; objective totals agree to 2e-5
-    "008-sr_battery_multiyr.csv",
-    # FR/SR/NSR capacity all priced: CPU assigns the capacity revenue to
-    # one stream, PDHG splits it; 'DA ETS' differs by $15 ABSOLUTE on a
-    # $15-scale column; objective totals agree to 1e-8
-    "029-DA_FR_SR_NSR_battery_month_ts_constraints.csv",
-}
+# r4 carved out three inputs here (027/008/029: co-priced reserve
+# streams made per-column revenue attribution non-unique).  r5 closed
+# 008 and 029: MarketService tilts each service's optimization price by
+# TIEBREAK_EPS x rank (markets.py) so the split is unique, and this
+# check runs the jax backend at eps_rel=1e-6 so the first-order solver
+# actually lands on the tilted vertex.  027 (PV+ICE+FR/SR/NSR, 8
+# streams) remains: its NSR column is ~$0 on the exact vertex, and
+# pinning a near-zero column to 1% of its own scale needs ~1e-7-of-
+# objective allocation accuracy on a near-degenerate face — beyond a
+# first-order method's practical resolution (measured $728 absolute on
+# a ~$1M NPV at eps_rel=1e-6).  For it, parity is asserted on NPV and
+# each year's NET proforma row.
+DEGENERATE_SPLIT = {"027-DA_FR_SR_NSR_pv_ice_month.csv"}
 
 
 # Default-suite parity slice (VERDICT r5 #6): small inputs spanning DA,
@@ -107,25 +102,38 @@ FAST_PARITY_SLICE = [
 
 @pytest.mark.parametrize("name", FAST_PARITY_SLICE)
 def test_backend_parity_default_slice(name):
-    _check_backend_parity(name)
+    # product-default tolerance: this is the default suite's regression
+    # gate on the REAL product path (the slice inputs have no co-priced
+    # degeneracy, so default accuracy passes the per-column check)
+    _check_backend_parity(name, tight=False)
 
 
 @pytest.mark.slow
 @pytest.mark.parametrize(
     "name", [n for n in runnable_csvs() if n not in FAST_PARITY_SLICE])
 def test_backend_parity_cpu_vs_jax(name):
-    _check_backend_parity(name)
+    _check_backend_parity(name, tight=True)
 
 
-def _check_backend_parity(name):
+def _check_backend_parity(name, tight):
     import numpy as np
+
+    from dervet_tpu.ops.pdhg import PDHGOptions
 
     path = MP / name
     try:
         res_c = DERVET(path, base_path=REF).solve(backend="cpu")
     except (ModelParameterError, TimeseriesDataError) as e:
         pytest.skip(f"input not runnable here: {e}")
-    res_j = DERVET(path, base_path=REF).solve(backend="jax")
+    # tight: the per-column 1% gate on a small ($10-scale) proforma
+    # column demands ~1e-7 of the window objective — beyond the product
+    # default eps_rel=1e-4.  The market tie-break (markets.py
+    # TIEBREAK_EPS) makes the optimum unique; the tighter tolerance
+    # makes the first-order solver land on it closely enough to compare
+    # columns (VERDICT r5 #8).
+    opts = PDHGOptions(eps_rel=1e-6, eps_abs=1e-8) if tight else None
+    res_j = DERVET(path, base_path=REF).solve(
+        backend="jax", solver_opts=opts)
     assert res_c.instances.keys() == res_j.instances.keys()
     for key in res_c.instances:
         ic, ij = res_c.instances[key], res_j.instances[key]
