@@ -243,6 +243,46 @@ V5E_PEAK_FLOPS = 197e12
 V5E_PEAK_HBM = 819e9
 
 
+def _op_nnz_eff(solver) -> int:
+    """Effective multiply-add count of one matvec through the solver's
+    op: bands nb*m, wide-row pair r*(n+m), ELL residual its padded
+    table, dense m*n."""
+    from dervet_tpu.ops.pdhg import BandedOp, DenseOp
+
+    n, m = solver.lp.n, solver.lp.m
+    op = solver.op
+    if isinstance(op, BandedOp):
+        nnz_eff = len(op.offsets) * m
+        if op.wide_w is not None:
+            nnz_eff += int(op.wide_w.shape[0]) * (n + m)
+        if op.ell is not None:
+            nnz_eff += int(op.ell.data.shape[0] * op.ell.data.shape[1])
+            nnz_eff += int(op.ell.dense_blk.shape[0]
+                           * op.ell.dense_blk.shape[1])
+        return nnz_eff
+    if isinstance(op, DenseOp):
+        return m * n
+    return int(op.data.shape[0] * op.data.shape[1]) \
+        + int(op.dense_blk.shape[0] * op.dense_blk.shape[1])
+
+
+def _utilization_dict(flops: float, hbm: float, elapsed_s: float) -> dict:
+    fps = flops / elapsed_s
+    bps = hbm / elapsed_s
+    fu = fps / V5E_PEAK_FLOPS
+    bu = bps / V5E_PEAK_HBM
+    return {
+        "flops_per_s": round(fps, 1),
+        "hbm_bytes_per_s": round(bps, 1),
+        "flops_utilization": round(fu, 6),
+        "hbm_utilization": round(bu, 6),
+        "peak_flops_bf16": V5E_PEAK_FLOPS,
+        "peak_hbm_bytes": V5E_PEAK_HBM,
+        "roof": ("hbm-bandwidth-bound" if bu > fu else "compute-bound")
+        + " (modeled)",
+    }
+
+
 def hardware_utilization(solvers, group_iters, elapsed_s) -> dict:
     """Achieved FLOP/s + modeled HBM bytes/s for one timed run.
 
@@ -258,25 +298,11 @@ def hardware_utilization(solvers, group_iters, elapsed_s) -> dict:
     (b) ~20 (n+m)-float array passes per instance per restart/KKT check
     (every check_every iterations at the then-active batch width).
     Whichever utilization is higher is the roof the path sits under."""
-    from dervet_tpu.ops.pdhg import BandedOp, DenseOp
-
     flops = 0.0
     hbm = 0.0
     for solver, iters in zip(solvers, group_iters):
         n, m = solver.lp.n, solver.lp.m
-        op = solver.op
-        if isinstance(op, BandedOp):
-            nnz_eff = len(op.offsets) * m
-            if op.wide_w is not None:
-                r = int(op.wide_w.shape[0])
-                nnz_eff += r * (n + m)
-            if op.ell is not None:
-                nnz_eff += int(op.ell.data.shape[0] * op.ell.data.shape[1])
-        elif isinstance(op, DenseOp):
-            nnz_eff = m * n
-        else:                      # EllOp
-            nnz_eff = int(op.data.shape[0] * op.data.shape[1])
-            nnz_eff += int(op.dense_blk.shape[0] * op.dense_blk.shape[1])
+        nnz_eff = _op_nnz_eff(solver)
         inst_iters = float(np.sum(iters))
         flops += inst_iters * (4.0 * nnz_eff + 10.0 * (n + m))
         chunk = solver.opts.compact_chunk_iters
@@ -285,20 +311,7 @@ def hardware_utilization(solvers, group_iters, elapsed_s) -> dict:
         n_checks = float(np.sum(np.ceil(iters / max(check, 1))))
         hbm += n_chunks * 2.0 * (7 * n + 5 * m) * 4.0
         hbm += n_checks * 20.0 * (n + m) * 4.0
-    fps = flops / elapsed_s
-    bps = hbm / elapsed_s
-    fu = fps / V5E_PEAK_FLOPS
-    bu = bps / V5E_PEAK_HBM
-    return {
-        "flops_per_s": round(fps, 1),
-        "hbm_bytes_per_s": round(bps, 1),
-        "flops_utilization": round(fu, 6),
-        "hbm_utilization": round(bu, 6),
-        "peak_flops_bf16": V5E_PEAK_FLOPS,
-        "peak_hbm_bytes": V5E_PEAK_HBM,
-        "roof": ("hbm-bandwidth-bound" if bu > fu else "compute-bound")
-        + " (modeled)",
-    }
+    return _utilization_dict(flops, hbm, elapsed_s)
 
 
 def sensitivity_leg() -> dict:
@@ -391,10 +404,17 @@ def long_horizon_leg() -> dict:
     (T, lps), = groups.items()
     lp = lps[0]
     t_asm = time.time() - t0
-    t0 = time.time()
-    solver = CompiledLPSolver(lp, PDHGOptions(chunk_iters=8192,
-                                              max_iters=200_000))
-    t_pre = time.time() - t0
+    # best-of-2 fresh builds, same policy as the main metric's sampling:
+    # the dominant precondition cost is a ~4 MB op transfer over the
+    # shared tunnel, whose throughput fluctuates >10x run to run
+    # (observed 1.8 s vs 12.6 s for the same bytes); a single sample
+    # would report tunnel weather, not the code's cost
+    t_pre = np.inf
+    for _ in range(2):
+        t0 = time.time()
+        solver = CompiledLPSolver(lp, PDHGOptions(chunk_iters=8192,
+                                                  max_iters=200_000))
+        t_pre = min(t_pre, time.time() - t0)
     t0 = time.time()
     res = solver.solve()
     t_cold = time.time() - t0
@@ -425,24 +445,11 @@ def long_horizon_leg() -> dict:
     # utilization for the UNBATCHED scan path: carries live in HBM, so
     # every iteration re-reads/writes ~12 state/temp vectors of (n+m)
     # plus the band tables — this leg should sit under the HBM roof
-    from dervet_tpu.ops.pdhg import BandedOp
-    op = solver.op
-    nnz_eff = lp.K.nnz
-    if isinstance(op, BandedOp):
-        nnz_eff = len(op.offsets) * lp.m
-        if op.wide_w is not None:
-            nnz_eff += int(op.wide_w.shape[0]) * (lp.n + lp.m)
-        if op.ell is not None:
-            nnz_eff += int(op.ell.data.shape[0] * op.ell.data.shape[1])
+    nnz_eff = _op_nnz_eff(solver)
     it = float(res.iters)
-    fps = it * (4.0 * nnz_eff + 10.0 * (lp.n + lp.m)) / t_warm
-    bps = it * (12.0 * (lp.n + lp.m) + nnz_eff) * 4.0 / t_warm
-    util = {"flops_per_s": round(fps, 1), "hbm_bytes_per_s": round(bps, 1),
-            "flops_utilization": round(fps / V5E_PEAK_FLOPS, 6),
-            "hbm_utilization": round(bps / V5E_PEAK_HBM, 6),
-            "roof": ("hbm-bandwidth-bound"
-                     if bps / V5E_PEAK_HBM > fps / V5E_PEAK_FLOPS
-                     else "compute-bound") + " (modeled)"}
+    util = _utilization_dict(
+        it * (4.0 * nnz_eff + 10.0 * (lp.n + lp.m)),
+        it * (12.0 * (lp.n + lp.m) + nnz_eff) * 4.0, t_warm)
     return {"T": int(T), "n": int(lp.n), "m": int(lp.m),
             "chip_solve_cold_s": round(t_cold, 2),
             "chip_solve_warm_s": round(t_warm, 2),

@@ -125,10 +125,13 @@ def sizing_sweep(case: CaseParams, kw_grid: Sequence[float],
                 [bool(v) for v in ok])
 
     # one thread per window-length group: the groups compile DIFFERENT
-    # XLA programs, and compiling them concurrently (compiles release the
-    # GIL) collapses the sweep's cold start — same pattern as bench.py's
-    # warm-up.  Device execution still interleaves safely (per-solver
-    # locks; distinct solvers here)
+    # XLA programs, and compiling them concurrently (remote compiles
+    # release the GIL) collapses the sweep's cold start — same pattern
+    # as bench.py's warm-up.  Unlike run_dispatch, the pool is NOT
+    # capped by cpu_count: measured on the 1-CPU bench host, threaded
+    # steady state is a wash vs serial (39.2 s vs ~41 s — one big solve
+    # per group, little host-side contention) while cold start improves
+    # 3.3x (340 s -> 103 s), so compile overlap pays for the pool.
     import concurrent.futures as cf
     items = sorted(groups.items())
     with cf.ThreadPoolExecutor(max_workers=max(1, len(items))) as pool:

@@ -223,12 +223,17 @@ def independent_window_objective(case, index: pd.DatetimeIndex) -> float:
     c[DIS:DIS + T] += bp["var_om"] * dt
     const += bp["fixed_om"] * bp["dis_cap"] * (T * dt) / 8760.0
     # the product tilts each service's optimization price by
-    # TIEBREAK_EPS x rank for a unique split between co-priced streams
-    # (markets.py); mirrored here so window objectives stay comparable
-    rank = {"FR": 1, "SR": 2, "NSR": 3, "LF": 4}
+    # TIEBREAK_EPS x rank for a unique split between co-priced streams;
+    # mirrored here so window objectives stay comparable.  The constants
+    # are imported, not copied — independence is of the LP CONSTRUCTION,
+    # and a silently desynchronized epsilon would fail every co-priced
+    # input with an error blaming assembly (review r5)
+    from dervet_tpu.models.streams.markets import MarketService
+    rank = MarketService.TIEBREAK_RANK
+    eps = MarketService.TIEBREAK_EPS
     for i, (tag, direction, price, k, dur, _lo, _hi) in enumerate(bids):
         o = bid_off(i)
-        tilt = 1.0 - 1e-3 * rank.get(tag, 0)
+        tilt = 1.0 - eps * rank.get(tag, 0)
         c[o:o + T] += -price * dt * tilt                 # capacity revenue
         sign = -1.0 if direction == "up" else +1.0       # energy settlement
         c[o:o + T] += sign * k * da_price * dt
