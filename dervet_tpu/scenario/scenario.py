@@ -418,10 +418,6 @@ class MicrogridScenario:
                 self.ders, self.opt_years, self.index)
         self._pending = list(windows)
 
-    # id(K) -> K-bytes digest, weakly keyed via the id of a LIVE matrix
-    # held in the value tuple (guards against id reuse after gc)
-    _skey_memo: Dict[int, tuple] = {}
-
     @staticmethod
     def _structure_key(lp: LP):
         """Windows whose constraint matrix is byte-identical (and split
@@ -429,32 +425,24 @@ class MicrogridScenario:
         structure (e.g. EV plug sessions) falls into its own group
         automatically.  Cases differing only in prices/bounds/rhs produce
         equal keys, so sensitivity cases batch together across the case
-        axis for free.  The key is a cryptographic digest of the actual
-        bytes, NOT Python's salted 64-bit hash: a 64-bit collision would
-        silently co-batch mismatched LPs and solve them with the wrong
-        eq_mask (ADVICE r3), while a full-bytes key would retain and
-        compare MB-scale strings per group for the dispatch lifetime.
-        Template-sharing (build_data) makes sibling cases carry the SAME
-        K object, so the digest is memoized by matrix identity — hashing
-        ~60 KB x 1,536 windows cost ~0.5 s of a sweep's assembly."""
-        import hashlib
-
-        import weakref
-
-        memo = MicrogridScenario._skey_memo
-        entry = memo.get(id(lp.K))
-        dig = None
-        if entry is not None and entry[0]() is lp.K:
-            dig = entry[1]
+        axis for free.  The key is a cryptographic digest, NOT Python's
+        salted 64-bit hash: a 64-bit collision would silently co-batch
+        mismatched LPs and solve them with the wrong eq_mask (ADVICE r3).
+        Builder-made LPs reuse the structure digest the builder computed
+        once and shared across template siblings — equal digests imply
+        byte-identical K and eq/ineq split (the build_data contract), so
+        no re-hash of ~60 KB x 1,536 windows per sweep.  An LP without
+        one (hand-built in tests) falls back to hashing K's bytes."""
+        dig = lp.structure_digest
         if dig is None:
+            import hashlib
+
             h = hashlib.sha256()
             h.update(lp.K.indptr.tobytes())
             h.update(lp.K.indices.tobytes())
             h.update(lp.K.data.tobytes())
             dig = h.digest()
-            if len(memo) > 4096:     # drop stale id->dead-weakref entries
-                memo.clear()
-            memo[id(lp.K)] = (weakref.ref(lp.K), dig)
+            lp.structure_digest = dig
         return (lp.K.shape, lp.n_eq, dig)
 
     def _cheap_group_key(self, ctx) -> tuple:

@@ -18,8 +18,9 @@ optimum even when the argmin is degenerate, so the check is exact
 (~1e-6 relative) wherever the formulations agree.
 
 Families covered: FR (001), SR (006), NSR (005), DR day-ahead (015),
-User (011) from reference inputs; LF synthesized from 000 by adding the
-LF price / energy-option columns (the snapshot ships no LF input).
+User (011) from reference inputs; LF, EV1, and VoltVar synthesized from
+000 (the snapshot ships no input for those three) — every family
+VERDICT r5 #5 names.
 
 Run directly (prints one line per case) or through
 ``tests/test_crosscheck.py`` (``--runslow``).
@@ -47,6 +48,8 @@ CASES = {
     "DR": "015-DA_DRdayahead_battery_month.csv",
     "User": "011-DA_User_battery_month.csv",
     "LF": None,                      # synthesized, see make_lf_case()
+    "EV1": None,                     # synthesized, see make_ev1_case()
+    "Volt": None,                    # synthesized, see make_volt_case()
 }
 
 
@@ -135,13 +138,24 @@ def independent_window_objective(case, index: pd.DatetimeIndex) -> float:
     """Optimal objective of one window, re-derived from SURVEY §2.8.
 
     Variable layout (deliberately different from the product's):
-      x = [ch(T), dis(T), ene(T), bid_0(T), bid_1(T), ...]
+      x = [ch(T), dis(T), ene(T), bid_0(T), ..., ev_ch(T)?]
     """
     ts = case.datasets.time_series.loc[index]
     dt = float(case.scenario.get("dt", 1) or 1)
     T = len(index)
     bp = _battery_params(case)
     da_price = _col(ts, "DA Price ($/kWh)")
+
+    ev_keys = next((k for t, _i, k in case.ders
+                    if t == "ElectricVehicle1"), None)
+
+    # VoltVar: per-step real-power derate of inverter caps,
+    # P <= cap * sqrt(1 - (r/100)^2)
+    derate = np.ones(T)
+    if "Volt" in case.streams:
+        r = np.clip(np.asarray(_col(ts, "VAR Reservation (%)")) / 100.0,
+                    0.0, 1.0)
+        derate = np.sqrt(np.maximum(1.0 - r ** 2, 0.0))
 
     # fixed site load (POI: incl_site_load, no ControllableLoad DER here)
     # + DER fixed loads (battery house power)
@@ -209,8 +223,9 @@ def independent_window_objective(case, index: pd.DatetimeIndex) -> float:
                          np.zeros(T), dur, lo, hi))
 
     nb = len(bids)
-    n = 3 * T + nb * T
+    n = 3 * T + nb * T + (T if ev_keys is not None else 0)
     CH, DIS, ENE = 0, T, 2 * T
+    EV = 3 * T + nb * T              # EV charge block, when present
 
     def bid_off(i):
         return 3 * T + i * T
@@ -241,10 +256,18 @@ def independent_window_objective(case, index: pd.DatetimeIndex) -> float:
     # --- bounds ----------------------------------------------------------
     lb = np.zeros(n)
     ub = np.full(n, np.inf)
-    ub[CH:CH + T] = bp["ch_cap"]
-    ub[DIS:DIS + T] = bp["dis_cap"]
+    ub[CH:CH + T] = bp["ch_cap"] * derate
+    ub[DIS:DIS + T] = bp["dis_cap"] * derate
     lb[ENE:ENE + T] = bp["e_lo"]
     ub[ENE:ENE + T] = bp["e_hi"]
+    if ev_keys is not None:
+        g = lambda k, d=0.0: float(ev_keys.get(k, d) or 0.0)
+        hours = np.asarray(index.hour)
+        t_in, t_out = int(g("plugin_time")), int(g("plugout_time"))
+        plugged = ((hours >= t_in) & (hours < t_out)) if t_in <= t_out \
+            else ((hours >= t_in) | (hours < t_out))
+        ub[EV:EV + T] = np.where(plugged, g("ch_max_rated"), 0.0)
+        c[EV:EV + T] += da_price * dt        # EV charging is a load
     for i, (_t, _d, _p, _k, _dur, blo, bhi) in enumerate(bids):
         o = bid_off(i)
         if blo is not None:
@@ -286,6 +309,28 @@ def independent_window_objective(case, index: pd.DatetimeIndex) -> float:
         add(r, bid_off(idn) + np.arange(T), -np.ones(T))
         rhs_eq.append(np.zeros(T))
         nrow += T
+    # EV1 session energy: each plugged session FULLY inside the window
+    # must deliver ene_target (independent re-derivation: sessions
+    # touching either window boundary carry no equality)
+    if ev_keys is not None:
+        sid = np.zeros(T, np.int64)
+        s_ = 0
+        prev = False
+        for t, p in enumerate(plugged):
+            if p and not prev:
+                s_ += 1
+            sid[t] = s_ if p else 0
+            prev = p
+        for s_no in range(1, s_ + 1):
+            idx_s = np.nonzero(sid == s_no)[0]
+            if (idx_s[0] == 0 and plugged[0]) or \
+                    (idx_s[-1] == T - 1 and plugged[-1]):
+                continue
+            add(np.full(len(idx_s), nrow), EV + idx_s,
+                np.full(len(idx_s), dt))
+            rhs_eq.append(np.array([float(ev_keys.get("ene_target", 0)
+                                          or 0)]))
+            nrow += 1
     n_eq = nrow
 
     # --- inequalities (A_ub x <= b_ub) ----------------------------------
@@ -322,7 +367,8 @@ def independent_window_objective(case, index: pd.DatetimeIndex) -> float:
         b_ub.append(np.full(T, pcap))
         nub += T
 
-    # POI interconnection limits: max_import <= dis - ch - load <= max_export
+    # POI interconnection limits:
+    # max_import <= dis - ch - ev_ch - load <= max_export
     if bool(case.scenario.get("apply_interconnection_constraints", False)):
         max_exp = float(case.scenario.get("max_export", 0) or 0)
         max_imp = float(case.scenario.get("max_import", 0) or 0)
@@ -330,6 +376,8 @@ def independent_window_objective(case, index: pd.DatetimeIndex) -> float:
             r = nub + np.arange(T)
             add_ub(r, DIS + np.arange(T), np.full(T, sgn))
             add_ub(r, CH + np.arange(T), np.full(T, -sgn))
+            if ev_keys is not None:
+                add_ub(r, EV + np.arange(T), np.full(T, -sgn))
             b_ub.append(np.full(T, lim) + sgn * load)
             nub += T
 
@@ -394,9 +442,11 @@ def independent_window_objective(case, index: pd.DatetimeIndex) -> float:
             add_ub(r, DIS + np.arange(T), np.full(T, sgn))
             b_ub.append(sgn * arr)
         elif kind == "poi export":
-            # net export = dis - ch - load
+            # net export = dis - ch - ev_ch - load
             add_ub(r, DIS + np.arange(T), np.full(T, sgn))
             add_ub(r, CH + np.arange(T), np.full(T, -sgn))
+            if ev_keys is not None:
+                add_ub(r, EV + np.arange(T), np.full(T, -sgn))
             b_ub.append(sgn * (arr + load))
         nub += T
 
@@ -442,6 +492,29 @@ def make_lf_case():
     return case
 
 
+def make_ev1_case():
+    """Battery + DA + a single plug-session EV (no reference EV input)."""
+    from dervet_tpu.io.params import Params
+    cases = Params.initialize(MP / "000-DA_battery_month.csv", base_path=REF)
+    case = cases[0]
+    case.ders.append(("ElectricVehicle1", "1", {
+        "name": "ev1", "ch_max_rated": 50, "ch_min_rated": 0,
+        "ene_target": 80, "plugin_time": 19, "plugout_time": 7}))
+    return case
+
+
+def make_volt_case():
+    """Battery + DA + VoltVar reactive-power reservation."""
+    from dervet_tpu.io.params import Params
+    cases = Params.initialize(MP / "000-DA_battery_month.csv", base_path=REF)
+    case = cases[0]
+    ts = case.datasets.time_series
+    rng = np.random.default_rng(7)
+    ts["VAR Reservation (%)"] = rng.uniform(0, 60, len(ts)).round(1)
+    case.streams["Volt"] = {}
+    return case
+
+
 def crosscheck_case(family: str, max_windows: int = 12) -> float:
     """Run the product path and the independent model; return the worst
     relative window-objective mismatch."""
@@ -450,6 +523,10 @@ def crosscheck_case(family: str, max_windows: int = 12) -> float:
 
     if family == "LF":
         case = make_lf_case()
+    elif family == "EV1":
+        case = make_ev1_case()
+    elif family == "Volt":
+        case = make_volt_case()
     else:
         cases = Params.initialize(MP / CASES[family], base_path=REF)
         case = cases[0]

@@ -1,7 +1,8 @@
 """Independent-formulation cross-check (VERDICT r5 #5).
 
-For stream families with no reference golden (FR/SR/NSR/LF, DR, User —
-their executable spec lives in the missing StorageVET layer), every
+For stream families with no reference golden (FR/SR/NSR/LF, DR, User,
+EV1, VoltVar — their executable spec lives in the missing StorageVET
+layer), every
 window's LP is re-assembled by a SECOND, independent stack
 (``scripts/crosscheck_formulation.py``: flat-index scipy COO + linprog,
 no LPBuilder) and the optimal window objectives must agree.  Two
