@@ -5,17 +5,24 @@
 # tunnel shows +/-15% run-to-run noise).
 set -euo pipefail
 
-echo "=== 1. default test suite (~7 min; expect ~280 passed) ==="
+echo "=== 1. default test suite (~7 min; expect ~283 passed, incl. the"
+echo "       5-input cpu-vs-jax parity slice) ==="
 python -m pytest tests/ -x -q
 
-echo "=== 2. full suite incl. slow golden + CPU-vs-jax parity sweep"
-echo "       (~35 min; expect ~355 passed) ==="
+echo "=== 2. full suite incl. slow golden + CPU-vs-jax parity sweep +"
+echo "       independent-formulation cross-check (~50 min) ==="
 python -m pytest tests/ -q --runslow
 
+echo "=== 2b. independent-formulation cross-check alone (8 families,"
+echo "        expect every rel err <= ~1e-10) ==="
+python scripts/crosscheck_formulation.py
+
 echo "=== 3. north-star bench + product-scale legs (expect steady-state"
-echo "       ~2.5-3s, vs_baseline ~20-25x, pallas:true, 24000/24000"
-echo "       converged; sensitivity leg NPV parity <1e-2; long-horizon"
-echo "       chip warm ~4-5s vs HiGHS ~6-20s at obj rel err ~6e-8) ==="
+echo "       ~2.0-2.5s, vs_baseline ~25-30x, pallas:true, 24000/24000"
+echo "       converged, a utilization block per leg; sensitivity leg"
+echo "       ~2.2-2.9x warm over serial CPU with a phase split;"
+echo "       long-horizon end-to-end ~4.4-7s vs HiGHS ~6-8s at obj rel"
+echo "       err ~4e-7) ==="
 DERVET_TPU_NO_XLA_CACHE=1 python bench.py
 
 REF="${DERVET_REFERENCE:-/root/reference}"
