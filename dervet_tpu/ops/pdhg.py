@@ -373,11 +373,13 @@ def make_op(K_scaled, dense_bytes_limit: int = 32 * 1024 * 1024,
             return DenseOp(Kh=put(K_scaled.todense(), dtype))
         return _build_ell(sparse_part, dense_cols, blk, dtype, put)
     offsets = tuple(int(v) for v in cand)
-    band_pos = {d: b for b, d in enumerate(offsets)}
     diags = np.zeros((len(offsets), m), np.float64)
     rows_b = coo.row[on_band]
-    diags[np.fromiter((band_pos[d] for d in offs[on_band]), np.int64,
-                      int(on_band.sum())), rows_b] = coo.data[on_band]
+    # vectorized offset -> band index (a Python generator here cost
+    # ~0.2 s at year-LP nnz)
+    cand_sorted = np.argsort(cand)
+    pos = cand_sorted[np.searchsorted(cand[cand_sorted], offs[on_band])]
+    diags[pos, rows_b] = coo.data[on_band]
     resid_nnz = int((~on_band).sum())
     ell = wide_p = wide_w = None
     if resid_nnz and wide_ok:

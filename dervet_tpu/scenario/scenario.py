@@ -808,14 +808,29 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
         def stack_cast(attr):
             # single-pass cast to the solver dtype while stacking: the
             # default is f32, so stacking at f64 doubles host memory
-            # traffic only to cast on transfer
-            first = getattr(lps[0], attr)
+            # traffic only to cast on transfer.  A vector IDENTICAL
+            # across the group (e.g. costs in a bounds-only sensitivity
+            # sweep) collapses to 1-D — the solver broadcasts it ON
+            # DEVICE, so a (512, n) block never crosses the tunnel.
+            rows = [getattr(lp, attr) for lp in lps]
+            first = rows[0]
+            if all(r is first or np.array_equal(r, first)
+                   for r in rows[1:]):
+                return np.asarray(first, sdt)
             out = np.empty((len(lps), first.shape[0]), sdt)
-            for i, lp in enumerate(lps):
-                out[i] = getattr(lp, attr)
+            for i, r in enumerate(rows):
+                out[i] = r
             return out
 
         C, Q, L, U = (stack_cast(a) for a in ("c", "q", "l", "u"))
+        if all(a.ndim == 1 for a in (C, Q, L, U)):
+            # fully-degenerate group (nothing varies): keep one axis
+            # batched so solve() returns per-instance results — broadcast
+            # ON DEVICE so the transfer stays the 1-D vector (a host
+            # .copy() would materialize the (B, m) block this collapse
+            # exists to avoid)
+            import jax.numpy as jnp
+            Q = jnp.broadcast_to(jax.device_put(Q), (len(lps), Q.shape[0]))
         if len(jax.devices()) > 1:
             from ..parallel import scenario_mesh, solve_batch_sharded
             res, _ = solve_batch_sharded(solver, scenario_mesh(),
