@@ -418,6 +418,10 @@ class MicrogridScenario:
                 self.ders, self.opt_years, self.index)
         self._pending = list(windows)
 
+    # id(K) -> (weakref to K, K-bytes digest): template siblings share one
+    # K object, so each distinct matrix hashes once per dispatch
+    _skey_memo: Dict[int, tuple] = {}
+
     @staticmethod
     def _structure_key(lp: LP):
         """Windows whose constraint matrix is byte-identical (and split
@@ -425,24 +429,32 @@ class MicrogridScenario:
         structure (e.g. EV plug sessions) falls into its own group
         automatically.  Cases differing only in prices/bounds/rhs produce
         equal keys, so sensitivity cases batch together across the case
-        axis for free.  The key is a cryptographic digest, NOT Python's
-        salted 64-bit hash: a 64-bit collision would silently co-batch
-        mismatched LPs and solve them with the wrong eq_mask (ADVICE r3).
-        Builder-made LPs reuse the structure digest the builder computed
-        once and shared across template siblings — equal digests imply
-        byte-identical K and eq/ineq split (the build_data contract), so
-        no re-hash of ~60 KB x 1,536 windows per sweep.  An LP without
-        one (hand-built in tests) falls back to hashing K's bytes."""
-        dig = lp.structure_digest
-        if dig is None:
-            import hashlib
+        axis for free.  The key is a cryptographic digest of the ASSEMBLED
+        K's bytes, NOT Python's salted 64-bit hash (a collision would
+        co-batch mismatched LPs, ADVICE r3) and NOT the builder's
+        structure digest: builder coefficient streams differ between
+        months whose assembled K is byte-identical (monthly tariff masks),
+        and keying on the builder digest split Usecase2's 3 window groups
+        into 12 singles — a ~10x dispatch regression on the CPU test
+        platform (caught r5).  The id-memo (weakref-guarded against id
+        reuse) keeps the cost at one ~60 KB hash per DISTINCT matrix."""
+        import hashlib
+        import weakref
 
+        memo = MicrogridScenario._skey_memo
+        entry = memo.get(id(lp.K))
+        dig = None
+        if entry is not None and entry[0]() is lp.K:
+            dig = entry[1]
+        if dig is None:
             h = hashlib.sha256()
             h.update(lp.K.indptr.tobytes())
             h.update(lp.K.indices.tobytes())
             h.update(lp.K.data.tobytes())
             dig = h.digest()
-            lp.structure_digest = dig
+            if len(memo) > 4096:     # drop stale id->dead-weakref entries
+                memo.clear()
+            memo[id(lp.K)] = (weakref.ref(lp.K), dig)
         return (lp.K.shape, lp.n_eq, dig)
 
     def _cheap_group_key(self, ctx) -> tuple:
@@ -805,6 +817,8 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
 
         sdt = np.dtype(solver.opts.dtype)   # jnp scalar types are np-compatible
 
+        multi_dev = len(jax.devices()) > 1
+
         def stack_cast(attr):
             # single-pass cast to the solver dtype while stacking: the
             # default is f32, so stacking at f64 doubles host memory
@@ -812,10 +826,13 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
             # across the group (e.g. costs in a bounds-only sensitivity
             # sweep) collapses to 1-D — the solver broadcasts it ON
             # DEVICE, so a (512, n) block never crosses the tunnel.
+            # Single-device only: the sharded path pads + shard_maps its
+            # batched inputs, and broadcast views there measured a
+            # pathological slowdown on the virtual-device test platform.
             rows = [getattr(lp, attr) for lp in lps]
             first = rows[0]
-            if all(r is first or np.array_equal(r, first)
-                   for r in rows[1:]):
+            if not multi_dev and all(r is first or np.array_equal(r, first)
+                                     for r in rows[1:]):
                 return np.asarray(first, sdt)
             out = np.empty((len(lps), first.shape[0]), sdt)
             for i, r in enumerate(rows):

@@ -108,11 +108,21 @@ def test_backend_parity_default_slice(name):
     _check_backend_parity(name, tight=False)
 
 
+# Only the formerly-degenerate co-priced inputs need the tighter solver
+# tolerance to pin their per-column splits (see _check_backend_parity);
+# running the whole sweep tight costs minutes PER INPUT (the jax path
+# iterates ~10x longer at eps_rel 1e-6) for no added evidence elsewhere.
+TIGHT_TOLERANCE = {
+    "008-sr_battery_multiyr.csv",
+    "029-DA_FR_SR_NSR_battery_month_ts_constraints.csv",
+}
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize(
     "name", [n for n in runnable_csvs() if n not in FAST_PARITY_SLICE])
 def test_backend_parity_cpu_vs_jax(name):
-    _check_backend_parity(name, tight=True)
+    _check_backend_parity(name, tight=name in TIGHT_TOLERANCE)
 
 
 def _check_backend_parity(name, tight):
