@@ -118,7 +118,24 @@ class DERVET:
         t_post = time.time()
         TellUser.debug(f"dispatch ({len(scenarios)} case(s)): "
                        f"{t_post - t_solve:.2f}s")
+        # run-health report (resilience layer): per-window ladder counts
+        # aggregated over the sweep, logged AND attached to the results so
+        # save_as_csv persists it next to the output set.  Quarantined
+        # cases are excluded from result collection — their partial
+        # dispatch is not a valid result — but stay visible here.
+        from .io.summary import log_health_report, run_health_report
+        report = run_health_report(
+            {key: getattr(s, "health", {}) for key, s in scenarios.items()},
+            {key: s.quarantine for key, s in scenarios.items()
+             if s.quarantine is not None})
+        results.run_health = report
+        log_health_report(report)
         for key, scenario in scenarios.items():
+            if scenario.quarantine is not None:
+                TellUser.error(
+                    f"case {key} excluded from results (quarantined): "
+                    f"{scenario.quarantine['reason']}")
+                continue
             results.add_instance(key, scenario)
         results.sensitivity_summary()
         done = time.time()

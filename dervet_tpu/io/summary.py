@@ -1,6 +1,11 @@
 """Pre-run input echo (reference: storagevet.Visualization.class_summary,
 invoked from dervet/DERVET.py:68-70 in verbose mode): prints every active
-tag's keys/values so the user can confirm what was loaded."""
+tag's keys/values so the user can confirm what was loaded.
+
+Also builds the RUN-HEALTH report (resilience layer): per-run counts of
+clean / inaccurate-accepted / retried / CPU-fallback / quarantined windows
+plus escalation-ladder wall time, aggregated across the sweep's cases, so
+a large run's degradations are visible instead of silent."""
 from __future__ import annotations
 
 from typing import Dict
@@ -8,6 +13,11 @@ from typing import Dict
 import pandas as pd
 
 from ..utils.errors import TellUser
+
+# the one authoritative bucket list — scenario._new_health derives its
+# counters from this, so the dispatch loop and the report cannot drift
+HEALTH_KEYS = ("clean", "inaccurate", "retried", "cpu_fallback",
+               "quarantined", "skipped")
 
 
 def class_summary(cases: Dict) -> None:
@@ -26,3 +36,48 @@ def class_summary(cases: Dict) -> None:
         lines.append(f"--- Sensitivity: {len(cases)} cases ---")
         lines.append(first.sensitivity_df.to_string())
     TellUser.info("\n".join(lines))
+
+
+def run_health_report(health_by_case: Dict, quarantined: Dict) -> Dict:
+    """Aggregate per-case window-health counters into one run report.
+
+    ``health_by_case``: case key -> the scenario's ``health`` dict.
+    ``quarantined``: case key -> quarantine record (reason/window) for
+    cases dropped by the failure-isolation layer."""
+    totals = {k: 0 for k in HEALTH_KEYS}
+    retry_s = 0.0
+    for h in health_by_case.values():
+        for k in HEALTH_KEYS:
+            totals[k] += int(h.get(k, 0))
+        retry_s += float(h.get("retry_seconds", 0.0))
+    return {
+        "windows": totals,
+        "retry_seconds": round(retry_s, 3),
+        "cases_total": len(health_by_case),
+        "cases_quarantined": sorted(str(k) for k in quarantined),
+        "quarantine_reasons": {str(k): (q.get("reason") if
+                                        isinstance(q, dict) else str(q))
+                               for k, q in quarantined.items()},
+        "per_case": {str(k): {kk: h.get(kk, 0) for kk in
+                              HEALTH_KEYS + ("retry_seconds",)}
+                     for k, h in health_by_case.items()},
+    }
+
+
+def log_health_report(report: Dict) -> None:
+    """One TellUser line summarizing the run's solver health; WARNING when
+    anything degraded, INFO when the run was fully clean."""
+    t = report["windows"]
+    msg = ("run health: "
+           f"{t['clean']} clean / {t['inaccurate']} inaccurate-accepted / "
+           f"{t['retried']} retried / {t['cpu_fallback']} CPU-fallback / "
+           f"{t['quarantined']} quarantined / "
+           f"{t['skipped']} skipped window(s); "
+           f"escalation wall time {report['retry_seconds']:.3f}s")
+    if report["cases_quarantined"]:
+        msg += (f"; quarantined case(s) "
+                f"{', '.join(report['cases_quarantined'])}: "
+                + "; ".join(f"case {k}: {r}" for k, r in
+                            report["quarantine_reasons"].items()))
+    degraded = any(t[k] for k in HEALTH_KEYS if k != "clean")
+    (TellUser.warning if degraded else TellUser.info)(msg)

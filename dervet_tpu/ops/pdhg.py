@@ -98,6 +98,26 @@ STATUS_PRIMAL_INFEASIBLE = 2
 # (storagevet Scenario solve-status check, SURVEY.md §2.8)
 STATUS_INACCURATE = 3
 
+# one human-readable diagnosis per status code: with hundreds of batched
+# windows, a failure labeled with the wrong generic message ("iteration
+# limit" for an inaccurate exit, say) sends the operator down the wrong
+# tuning path
+STATUS_MESSAGES = {
+    STATUS_CONVERGED: "converged",
+    STATUS_ITER_LIMIT: "iteration limit reached before convergence",
+    STATUS_PRIMAL_INFEASIBLE: "primal infeasibility certified by the "
+                              "dual ray",
+    STATUS_INACCURATE: "solved to reduced accuracy (KKT within the "
+                       "inaccurate-factor tolerance at the iteration "
+                       "limit)",
+}
+
+
+def status_message(code) -> str:
+    """Human-readable message for a PDHGResult.status code."""
+    return STATUS_MESSAGES.get(int(code),
+                               f"unrecognized solver status {int(code)}")
+
 
 # ---------------------------------------------------------------------------
 # Preconditioning (host-side, numpy — runs once per problem structure)
@@ -1055,6 +1075,33 @@ class CompiledLPSolver:
                                         self.opts, self.op))
         self._jit_fin_b = jax.jit(jax.vmap(self._solve.finalize,
                                            in_axes=data_axes + (0,)))
+
+    def with_options(self, opts: PDHGOptions) -> "CompiledLPSolver":
+        """Clone sharing this solver's preconditioning (Ruiz scaling, the
+        ||K|| power-iteration step size, and the device-resident operator)
+        under different runtime options — the per-member re-solve entry
+        point for the escalation ladder's boosted-budget retry, where
+        paying the preconditioning again for a handful of failed batch
+        members would dominate the retry itself.  Only runtime options may
+        change: options that shape the operator or the compiled program's
+        data types must match the base solver."""
+        for field in ("dtype", "dense_bytes_limit", "precision",
+                      "ruiz_iters", "power_iters", "step_size_safety"):
+            if getattr(opts, field) != getattr(self.opts, field):
+                raise ValueError(
+                    f"with_options cannot change {field!r} — it is baked "
+                    "into the preconditioned operator; build a fresh "
+                    "CompiledLPSolver instead")
+        import threading
+        clone = object.__new__(CompiledLPSolver)
+        clone.opts = opts
+        clone.lp = self.lp
+        clone.op, clone.dr, clone.dc, clone.eta = (self.op, self.dr,
+                                                   self.dc, self.eta)
+        clone.precondition_breakdown = dict(self.precondition_breakdown)
+        clone._make_jits()
+        clone._solve_lock = threading.Lock()
+        return clone
 
     def _data(self, c, q, l, u):
         lp = self.lp

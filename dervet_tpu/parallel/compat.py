@@ -1,0 +1,18 @@
+"""jax version compatibility for the parallel modules.
+
+The sharded solvers target the jax >= 0.6 API (top-level ``jax.shard_map``
+with ``check_vma``). On older jax the same entry point lives in
+``jax.experimental.shard_map`` and the varying-manual-axes checker is the
+replication checker ``check_rep`` — which has no rule for ``while_loop``,
+present in every PDHG chunk, so it must be disabled there.
+"""
+import jax
+
+try:                                    # jax >= 0.6 top-level alias
+    shard_map = jax.shard_map
+except AttributeError:                  # jax < 0.6: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False, **kw)

@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.pdhg import CompiledLPSolver, PDHGResult
+from .compat import shard_map
 
 AXIS = "scenario"
 
@@ -145,14 +146,14 @@ def _solve_batch_sharded_inner(solver: CompiledLPSolver, mesh: Mesh,
     res_specs = PDHGResult(x=P(AXIS), y=P(AXIS), obj=P(AXIS),
                            converged=P(AXIS), iters=P(AXIS),
                            prim_res=P(AXIS), gap=P(AXIS), status=P(AXIS))
-    sh_init = jax.jit(jax.shard_map(
+    sh_init = jax.jit(shard_map(
         local_init, mesh=mesh, in_specs=(P(AXIS),) * 4, out_specs=P(AXIS)))
     from ..ops.pdhg import pallas_compiler_options
-    sh_chunk = jax.jit(jax.shard_map(
+    sh_chunk = jax.jit(shard_map(
         local_chunk, mesh=mesh,
         in_specs=(P(AXIS),) * 4 + (P(AXIS), P()), out_specs=P(AXIS)),
         compiler_options=pallas_compiler_options(solver.opts, solver.op))
-    sh_fin = jax.jit(jax.shard_map(
+    sh_fin = jax.jit(shard_map(
         local_fin, mesh=mesh, in_specs=(P(AXIS),) * 4 + (P(AXIS), P(AXIS)),
         out_specs=(res_specs, ShardedStats(n_converged=P(), max_iters=P(),
                                            max_prim_res=P()))))

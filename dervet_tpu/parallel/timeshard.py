@@ -36,6 +36,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.lp import LP
+from .compat import shard_map
 from ..ops.pdhg import (EllOp, PDHGOptions, PDHGResult, ShardRowOp, _State,
                         _csr_to_ell, _make_solver, op_matvec, op_rmatvec,
                         ruiz_scaling)
@@ -163,7 +164,7 @@ class TimeShardedLPSolver:
 
         v0 = np.random.default_rng(0).standard_normal(n)
         v0 = jnp.asarray(v0 / np.linalg.norm(v0), dtype)
-        sig2 = jax.jit(jax.shard_map(
+        sig2 = jax.jit(shard_map(
             _power, mesh=mesh, in_specs=(op_spec, P()), out_specs=P(),
             check_vma=False))(self.op, v0)
         sigma_max = float(jnp.sqrt(sig2))
@@ -182,14 +183,14 @@ class TimeShardedLPSolver:
         # every row-space reduction inside is an explicit psum, so outputs
         # declared replicated ARE replicated; vma tracking cannot see that
         # through the while_loop carries, hence check_vma=False
-        self._init = jax.jit(jax.shard_map(
+        self._init = jax.jit(shard_map(
             solve.init_state, mesh=mesh, in_specs=data_specs,
             out_specs=state_spec, check_vma=False))
-        self._chunk = jax.jit(jax.shard_map(
+        self._chunk = jax.jit(shard_map(
             solve.run_chunk, mesh=mesh,
             in_specs=data_specs + (rep, state_spec, rep),
             out_specs=state_spec, check_vma=False))
-        self._fin = jax.jit(jax.shard_map(
+        self._fin = jax.jit(shard_map(
             solve.finalize, mesh=mesh, in_specs=data_specs + (state_spec,),
             out_specs=res_spec, check_vma=False))
 

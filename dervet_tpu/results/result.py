@@ -34,6 +34,9 @@ class Result:
         self.sensitivity_df = (sensitivity_df if sensitivity_df is not None
                                else pd.DataFrame())
         self.instances: Dict[int, CaseResult] = {}
+        # run-health report (resilience layer), attached by api.solve:
+        # per-window ladder counts + quarantined-case diagnoses
+        self.run_health: Optional[Dict] = None
 
     def add_instance(self, key: int, scenario) -> "CaseResult":
         inst = CaseResult(scenario, self.csv_label)
@@ -55,6 +58,14 @@ class Result:
 
     def save_as_csv(self, out_dir=None) -> None:
         out = Path(out_dir or self.dir_abs_path)
+        if self.run_health is not None:
+            # persisted next to the output set so a large sweep's solver
+            # degradations (retries, CPU fallbacks, quarantined cases) are
+            # auditable after the run, not just scrollback
+            import json
+            out.mkdir(parents=True, exist_ok=True)
+            (out / "run_health.json").write_text(
+                json.dumps(self.run_health, indent=2))
         for key, inst in self.instances.items():
             label = f"{self.csv_label}{key}" if len(self.instances) > 1 else self.csv_label
             inst.save_as_csv(out, label)

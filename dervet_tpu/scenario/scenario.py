@@ -14,6 +14,7 @@ GLPK role.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -27,8 +28,10 @@ from ..models.streams.base import ValueStream
 from ..models.streams.da import DAEnergyTimeShift
 from ..ops.lp import LP, LPBuilder
 from ..ops import cpu_ref
-from ..utils.errors import (MonthlyDataError, ParameterError, SolverError,
-                            TellUser, TimeseriesDataError)
+from ..utils import faultinject
+from ..utils.errors import (AggregatedSolverError, MonthlyDataError,
+                            ParameterError, SolverError, TellUser,
+                            TimeseriesDataError)
 from .aggregator import ServiceAggregator
 from .poi import POI
 from .window import WindowContext, make_windows
@@ -196,6 +199,13 @@ class MicrogridScenario:
                                     case.datasets.monthly, self.n, self.dt)
         self.objective_values: Dict[int, Dict[str, float]] = {}
         self.solve_metadata: Dict[str, Any] = {}
+        # case-level failure isolation (resilience layer): a case whose
+        # window exhausts the escalation ladder — or fails the pre-dispatch
+        # input guards — is quarantined with its diagnosis instead of
+        # killing the whole sweep; ``health`` counts every window's path
+        # through the ladder for the run-health report
+        self.quarantine: Optional[Dict[str, Any]] = None
+        self.health: Dict[str, Any] = _new_health()
 
     # ------------------------------------------------------------------
     def build_window_lp(self, ctx: WindowContext, annuity_scalar: float = 1.0,
@@ -362,6 +372,8 @@ class MicrogridScenario:
         self._checkpoint_dir = checkpoint_dir
         self._n_solves = 0
         self._ckpt_backlog = 0
+        self.quarantine = None
+        self.health = _new_health()
         self._solution: Dict[str, np.ndarray] = {}
         self._solved: set = set()
         deferral = self.streams.get("Deferral")
@@ -399,16 +411,26 @@ class MicrogridScenario:
             ctx0 = windows[0]
             pairs = [(ctx0, self.build_window_lp(ctx0, self._annuity_scalar,
                                                  self._requirements))]
-            xs, objs, ok, diags = solve_group(pairs[0][1], [pairs[0][1]],
-                                              "cpu", solver_opts)
+            items0 = guard_items([(self, ctx0, pairs[0][1])])
+            if not items0:
+                return          # sizing inputs rejected: case quarantined
+            health_snap = dict(self.health)
+            xs, objs, ok, diags = resolve_group(items0, "cpu", solver_opts)
             self.apply_subgroup(pairs, xs, objs, ok, diags, "cpu",
                                 freeze_sizes=True)
+            if self.quarantine is not None:
+                return          # sizing window exhausted the ladder
             # integer-sizing polish (VERDICT r3 #6): set_size snapped the
             # ratings onto the reference's integer grid, so the sizing
             # window's CONTINUOUS-size dispatch is stale — mark it
             # unsolved and let the batched driver re-solve it once at the
             # frozen integer ratings (degradation replay for it then runs
-            # through the normal phase-2 path against the final dispatch)
+            # through the normal phase-2 path against the final dispatch).
+            # The pre-solve was provisional: roll its bucket back so the
+            # re-solve's outcome is the window's ONE health entry (ladder
+            # wall time genuinely spent is kept)
+            health_snap["retry_seconds"] = self.health["retry_seconds"]
+            self.health = health_snap
             self._solved.discard(ctx0.label)
             # capacity-dependent requirements (Reliability min-SOE, RA
             # qualifying capacity) were computed against zero ratings;
@@ -479,7 +501,7 @@ class MicrogridScenario:
         non-degradation-coupled window.  No LP is built here — the driver
         builds each group's LPs once, at solve time, verifying exact
         structure then."""
-        if not self.opt_engine or self._degrading:
+        if not self.opt_engine or self._degrading or self.quarantine:
             return
         for ctx in self._pending:
             if ctx.label in self._solved:
@@ -493,7 +515,7 @@ class MicrogridScenario:
         """Advance through solved windows (replaying degradation), then
         return ``(structure_key, ctx, lp)`` for the first window that still
         needs a solve — or None when the case is done."""
-        if not self.opt_engine or not self._degrading:
+        if not self.opt_engine or not self._degrading or self.quarantine:
             return None
         while self._deg_pos < len(self._pending):
             ctx = self._pending[self._deg_pos]
@@ -517,7 +539,20 @@ class MicrogridScenario:
             if self._checkpoint_dir and self._solved:
                 self._save_checkpoint(self._checkpoint_dir, self._solution,
                                       self._solved)
-            self._scatter_to_ders(self._solution)
+            if self.quarantine is None:
+                self._scatter_to_ders(self._solution)
+            # windows never dispatched because the case quarantined first
+            # land in 'skipped', so a quarantined case's buckets still sum
+            # to n_windows and the report's denominators reconcile against
+            # sweep size.  (Clean cases need no plug: every window they
+            # dispatch this run is bucketed at solve time; windows
+            # restored from a checkpoint are not re-dispatched and are
+            # deliberately not counted.)
+            if self.quarantine is not None:
+                counted = sum(self.health[k] for k in self.health
+                              if k not in ("skipped", "retry_seconds"))
+                self.health["skipped"] = max(0,
+                                             len(self.windows) - counted)
         self.solve_metadata.update({
             "backend": self._backend,
             # wall-clock of the WHOLE batched dispatch this case rode in —
@@ -526,12 +561,46 @@ class MicrogridScenario:
             "solve_seconds": time.time() - self._t0,
             "batched_solves": self._n_solves,
             "n_windows": len(self.windows),
+            "health": dict(self.health),
+            "quarantined": self.quarantine,
         })
+
+    # ------------------------------------------------------------------
+    def _flush_checkpoint(self) -> None:
+        """Write any batched-up checkpoint state NOW — called before a
+        case leaves the dispatch loop (quarantine), so up to 8
+        already-solved degradation windows are not re-solved on resume."""
+        if self._checkpoint_dir and self._ckpt_backlog and self._solved:
+            self._save_checkpoint(self._checkpoint_dir, self._solution,
+                                  self._solved)
+            self._ckpt_backlog = 0
+
+    def quarantine_case(self, reason: str, label=None) -> None:
+        """Case-level failure isolation: mark this case failed with its
+        diagnosis and drop it from the remaining dispatch — the sweep's
+        other cases keep solving.  ``run_dispatch`` raises an aggregated
+        ``SolverError`` at the end only if EVERY case is quarantined."""
+        if self.quarantine is not None:
+            return
+        self._flush_checkpoint()
+        self.quarantine = {"case_id": self.case.case_id, "reason": reason,
+                           "window": label}
+        TellUser.error(f"case {self.case.case_id} quarantined"
+                       + (f" (window {label})" if label is not None else "")
+                       + f": {reason}")
 
     def apply_subgroup(self, pairs, xs, objs, ok, diags, backend,
                        freeze_sizes: bool = False) -> None:
         """Post-solve half of a window-group solve: binary MILP rescue,
-        objective bookkeeping, solution scatter, size freezing."""
+        objective bookkeeping, solution scatter, size freezing.  A member
+        still unconverged HERE has exhausted the escalation ladder
+        upstream (``resolve_group``): the case is quarantined — after the
+        converged members are recorded and the checkpoint flushed — so
+        the sweep's other cases continue instead of losing their work.
+        Runs even for an already-quarantined case: with pipelining a
+        group may still be in flight when a later group quarantines the
+        case, and its converged members must be recorded and
+        checkpointed, not thrown away."""
         ctxs = [p[0] for p in pairs]
         lps = [p[1] for p in pairs]
         solver_opts = self._solver_opts
@@ -549,7 +618,13 @@ class MicrogridScenario:
             for i, lp in enumerate(lps):
                 if lp.integrality is None:
                     continue
+                # binary windows were NOT bucketed in resolve_group — the
+                # outcome of the binary check / MILP rescue below is the
+                # window's final health bucket (failures join `failed`
+                # and count as quarantined)
                 if ok[i] and cpu_ref.binary_feasible(lp, xs[i], tol=bin_tol):
+                    with _health_lock:
+                        self.health["clean"] += 1
                     continue
                 # relaxation cheated (fractional on/off) — or failed to
                 # converge at all, which is the wrong abort criterion for
@@ -559,17 +634,24 @@ class MicrogridScenario:
                     + ("relaxation exploits fractional on/off"
                        if ok[i] else "relaxation did not converge")
                     + "; re-solving as exact MILP")
+                was_unconverged = not ok[i]
                 res = cpu_ref.solve_lp_cpu(lp)
                 xs[i], objs[i] = res.x, res.obj
                 ok[i] = res.status == 0
                 diags[i] = res.message or diags[i]
+                if ok[i]:
+                    # an unconverged relaxation rescued by the exact MILP
+                    # is a CPU-fallback recovery in health terms; a
+                    # fractional-on/off repair is normal binary operation
+                    with _health_lock:
+                        self.health["cpu_fallback" if was_unconverged
+                                    else "clean"] += 1
+        failed = []
         for ctx, lp, x, obj, converged, diag in zip(ctxs, lps, xs, objs, ok,
                                                     diags):
             if not converged:
-                msg = (f"window {ctx.label} ({ctx.index[0]}..{ctx.index[-1]}) "
-                       f"did not solve: {diag}")
-                TellUser.error(msg)
-                raise SolverError(msg)
+                failed.append((ctx, diag))
+                continue
             breakdown = lp.objective_breakdown(x)
             breakdown["Total Objective"] = float(obj) + lp.c0
             self.objective_values[ctx.label] = breakdown
@@ -590,17 +672,27 @@ class MicrogridScenario:
                              and name[len(prefix):].startswith("size")}
                     if sizes:
                         der.set_size(sizes)
-        self._solved.update(ctx.label for ctx in ctxs)
+            self._solved.add(ctx.label)
         if self._checkpoint_dir:
             # group solves checkpoint after every apply; the window-at-a-
             # time degradation path batches writes in strides of 8 —
             # full-horizon npz writes are not free (finish_dispatch writes
-            # the final state either way)
-            self._ckpt_backlog += len(ctxs)
-            if not self._degrading or self._ckpt_backlog >= 8:
+            # the final state either way).  A failure flushes the backlog
+            # unconditionally: the quarantine below drops this case from
+            # the dispatch, and an unflushed stride would re-solve up to 8
+            # already-solved windows on resume.
+            self._ckpt_backlog += len(ctxs) - len(failed)
+            if not self._degrading or self._ckpt_backlog >= 8 or failed:
                 self._save_checkpoint(self._checkpoint_dir, self._solution,
                                       self._solved)
                 self._ckpt_backlog = 0
+        if failed:
+            with _health_lock:
+                self.health["quarantined"] += len(failed)
+            ctx_f, diag_f = failed[0]
+            self.quarantine_case(
+                f"window {ctx_f.label} ({ctx_f.index[0]}..{ctx_f.index[-1]}) "
+                f"did not solve: {diag_f}", label=ctx_f.label)
 
     def check_opt_sizing_conditions(self) -> None:
         """Sizing feasibility guards (reference MicrogridScenario.py:208-247):
@@ -771,7 +863,18 @@ class SolverCache:
             solver = self.solvers.get(key)
             if solver is None:
                 from ..ops.pdhg import CompiledLPSolver, PDHGOptions
-                solver = CompiledLPSolver(lp0, solver_opts or PDHGOptions())
+                opts = solver_opts or PDHGOptions()
+                # escalation retries key as ("retry", base_key): clone the
+                # base structure's solver (shared preconditioning, new
+                # runtime budget) instead of re-preconditioning — see
+                # CompiledLPSolver.with_options
+                base = (self.solvers.get(key[1])
+                        if isinstance(key, tuple) and len(key) == 2
+                        and key[0] == "retry" else None)
+                if base is not None:
+                    solver = base.with_options(opts)
+                else:
+                    solver = CompiledLPSolver(lp0, opts)
                 self.solvers[key] = solver
                 self.builds += 1
             else:
@@ -780,25 +883,38 @@ class SolverCache:
 
 
 def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
-                key=None, cache: Optional[SolverCache] = None):
+                key=None, cache: Optional[SolverCache] = None, labels=None):
     """Solve a group of structure-identical LPs.  Backend 'cpu' = exact
     HiGHS per instance; 'jax' = ONE batched PDHG device call, sharded over
     the scenario-axis mesh when more than one accelerator is visible
     (SURVEY §2.10 DP row; transparent fallback to the single-device vmap
     path on one chip).  With ``key``/``cache`` set, the compiled solver is
-    reused across calls that share a structure key."""
+    reused across calls that share a structure key.  ``labels`` (parallel
+    to ``lps``) names each window in diagnostics.
+
+    Returns ``(xs, objs, ok, diags, statuses)`` — statuses are the
+    ``ops.pdhg.STATUS_*`` codes (CPU results are mapped onto them), so the
+    escalation ladder upstream can tell a certified infeasibility from an
+    iteration-limit exit."""
+    from ..ops.pdhg import (STATUS_CONVERGED, STATUS_INACCURATE,
+                            STATUS_ITER_LIMIT, STATUS_PRIMAL_INFEASIBLE,
+                            CompiledLPSolver, PDHGOptions,
+                            diagnose_infeasibility, status_message)
     if backend == "cpu":
-        xs, objs, ok, diags = [], [], [], []
+        xs, objs, ok, diags, statuses = [], [], [], [], []
         for lp in lps:
             res = cpu_ref.solve_lp_cpu(lp)
             xs.append(res.x)
             objs.append(res.obj)
             ok.append(res.status == 0)
             diags.append(getattr(res, "message", "") or "solver failure")
-        return xs, objs, ok, diags
-    from ..ops.pdhg import (STATUS_INACCURATE, STATUS_PRIMAL_INFEASIBLE,
-                            CompiledLPSolver, PDHGOptions,
-                            diagnose_infeasibility)
+            # scipy linprog/milp statuses: 0 optimal, 2 infeasible; map
+            # onto the PDHG codes the ladder dispatches on
+            statuses.append(
+                STATUS_CONVERGED if res.status == 0 else
+                STATUS_PRIMAL_INFEASIBLE if res.status == 2 else
+                STATUS_ITER_LIMIT)
+        return xs, objs, ok, diags, statuses
     if cache is not None and key is not None:
         solver = cache.get(key, lp0, solver_opts)
     else:
@@ -859,22 +975,280 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
         objs = [float(o) for o in np.asarray(res.obj)]
         ok = list(np.asarray(res.converged))
     # accept near-converged iteration-limit exits with a warning — the
-    # reference accepts CVXPY 'optimal_inaccurate' the same way
+    # reference accepts CVXPY 'optimal_inaccurate' the same way.  The
+    # warning names the window and its actual KKT residuals: with
+    # hundreds of batched windows an anonymous message is unactionable.
+    prim_res = np.atleast_1d(np.asarray(res.prim_res))
+    gaps = np.atleast_1d(np.asarray(res.gap))
+    factor = (solver_opts or PDHGOptions()).inaccurate_factor
     for i, s in enumerate(statuses):
         if s == STATUS_INACCURATE:
             ok[i] = True
+            name = labels[i] if labels is not None else f"#{i}"
             TellUser.warning(
-                "window solved to reduced accuracy (KKT within 10x "
-                "tolerance at the iteration limit)")
+                f"window {name} solved to reduced accuracy (KKT primal "
+                f"residual {float(prim_res[i]):.3e}, gap "
+                f"{float(gaps[i]):.3e}; within {factor:g}x tolerance at "
+                "the iteration limit)")
+    # each status code carries its own diagnosis (a mislabeled failure
+    # sends the operator down the wrong tuning path); certified
+    # infeasibilities get the dual-ray constraint-group ranking.  The
+    # dual block only leaves the device when a certificate needs it —
+    # an unconditional readback of (B, m) duals would tax every clean
+    # batched solve on the hot path.
     if STATUS_PRIMAL_INFEASIBLE in statuses:
         ys = np.asarray(res.y)
         diags = [diagnose_infeasibility(lp0, ys[i] if ys.ndim > 1 else ys)
-                 if s == STATUS_PRIMAL_INFEASIBLE else
-                 "iteration limit reached before convergence"
+                 if s == STATUS_PRIMAL_INFEASIBLE else status_message(s)
                  for i, s in enumerate(statuses)]
     else:
-        diags = ["iteration limit reached before convergence"] * len(statuses)
+        diags = [status_message(s) for s in statuses]
+    return xs, objs, ok, diags, statuses
+
+
+# ---------------------------------------------------------------------------
+# Resilience layer: input guards, escalation ladder, case isolation
+# ---------------------------------------------------------------------------
+
+# health counters are mutated from the dispatch pipeline's worker threads
+# (a case's windows may ride two concurrently-solving groups)
+_health_lock = threading.Lock()
+
+# escalation-ladder rung 1: re-solve failed members with 4x the iteration
+# budget and a 10x-relaxed inaccurate acceptance — PDLP-family solvers have
+# heavy-tailed iteration counts (PAPERS.md: MPAX), so a straggler that
+# misses the shared budget usually lands well within a boosted one
+LADDER_ITER_BOOST = 4
+LADDER_INACCURATE_RELAX = 10.0
+
+
+def _new_health() -> Dict[str, Any]:
+    """Per-case window accounting for the run-health report: every window
+    ends in exactly one bucket (clean / inaccurate-accepted / recovered on
+    retry / recovered on the CPU fallback / quarantined / skipped — never
+    dispatched because the case quarantined first); ``retry_seconds`` is
+    the case's share of ladder wall time.  The bucket set is
+    ``io.summary.HEALTH_KEYS`` so the loop and the report cannot drift."""
+    from ..io.summary import HEALTH_KEYS
+    return {**{k: 0 for k in HEALTH_KEYS}, "retry_seconds": 0.0}
+
+
+def _var_name_at(lp: LP, j: int) -> str:
+    for name, ref in lp.var_refs.items():
+        if ref.start <= j < ref.start + ref.size:
+            return f"{name}[{j - ref.start}]"
+    return f"x[{j}]"
+
+
+def validate_lp_inputs(lp: LP, label) -> Optional[str]:
+    """Pre-dispatch input guard: NaN/Inf in ``c``/``q`` or crossed bounds
+    (``l > u``) would make PDHG burn its whole iteration budget on poisoned
+    data (NaN propagates through every matvec and no restart recovers).
+    Returns a window-labeled diagnostic, or None when the inputs are
+    sound.  ``l``/``u`` may legitimately be +-inf (unbounded variables) —
+    only NaN and inverted boxes are rejected there."""
+    for name, arr in (("c (costs)", lp.c), ("q (constraint rhs)", lp.q)):
+        bad = ~np.isfinite(arr)
+        if bad.any():
+            j = int(np.argmax(bad))
+            where = (_var_name_at(lp, j) if name.startswith("c")
+                     else f"row {j}")
+            return (f"window {label}: {int(bad.sum())} non-finite "
+                    f"entr(ies) in {name}, first at {where}")
+    for name, arr in (("l", lp.l), ("u", lp.u)):
+        bad = np.isnan(arr)
+        if bad.any():
+            j = int(np.argmax(bad))
+            return (f"window {label}: NaN in bound vector {name} at "
+                    f"{_var_name_at(lp, j)}")
+    crossed = lp.l > lp.u
+    if crossed.any():
+        j = int(np.argmax(crossed))
+        return (f"window {label}: {int(crossed.sum())} crossed bound(s) "
+                f"(l > u), first at {_var_name_at(lp, j)} "
+                f"[l={lp.l[j]:g}, u={lp.u[j]:g}]")
+    return None
+
+
+def guard_items(items):
+    """Input guards at the batched boundary.  ``items`` is a list of
+    ``(scenario, ctx, lp)``; members of already-quarantined cases are
+    dropped, fault injection may poison a targeted case's inputs here, and
+    a member failing validation quarantines its case with the
+    window-labeled diagnostic BEFORE any device dispatch.  Returns the
+    members safe to solve."""
+    out = []
+    for s, ctx, lp in items:
+        if s.quarantine is not None:
+            continue
+        faultinject.maybe_poison(s.case.case_id, lp)
+        err = validate_lp_inputs(lp, ctx.label)
+        if err is not None:
+            with _health_lock:
+                s.health["quarantined"] += 1
+            s.quarantine_case(f"input guard rejected the window before "
+                              f"dispatch: {err}", label=ctx.label)
+            continue
+        out.append((s, ctx, lp))
+    return out
+
+
+def resolve_group(items, backend: str, solver_opts, key=None,
+                  cache: Optional[SolverCache] = None):
+    """Solve a window group with the per-window escalation ladder.
+
+    ``items`` is a list of ``(scenario, ctx, lp)`` (structure-identical
+    LPs).  The group solves once; members that exit non-converged then
+    climb the ladder in ``_escalate`` — boosted-budget retry, exact CPU
+    fallback — with ONLY the failed members re-solved.  Returns
+    ``(xs, objs, ok, diags)`` for ``apply_subgroup``; members still failed
+    after the ladder keep ``ok=False`` and their diagnosis, and the apply
+    step quarantines their case.
+
+    Fault injection (utils.faultinject) flips observed convergence here —
+    after the real solve, before the ladder — so tests drive every
+    recovery rung through the exact production path."""
+    from ..ops.pdhg import STATUS_CONVERGED, STATUS_INACCURATE, \
+        STATUS_ITER_LIMIT
+    lps = [lp for (_, _, lp) in items]
+    labels = [ctx.label for (_, ctx, _) in items]
+    xs, objs, ok, diags, statuses = solve_group(
+        lps[0], lps, backend, solver_opts, key=key, cache=cache,
+        labels=labels)
+    plan = faultinject.get_plan()
+    if plan is not None:
+        for i, (s, ctx, lp) in enumerate(items):
+            if ok[i] and plan.force_nonconverge(ctx.label,
+                                                faultinject.RUNG_SOLVE):
+                ok[i] = False
+                statuses[i] = STATUS_ITER_LIMIT
+                diags[i] = ("fault injection: forced non-convergence at "
+                            "rung 'solve'")
+    fail_idx = [i for i in range(len(items)) if not ok[i]]
+    with _health_lock:
+        for i, (s, ctx, lp) in enumerate(items):
+            # binary windows on an accelerated backend are counted in
+            # apply_subgroup instead: their relaxation's convergence here
+            # is provisional — the binary-feasibility check / exact-MILP
+            # rescue there decides the window's final bucket
+            if lp.integrality is not None and backend != "cpu":
+                continue
+            if ok[i]:
+                s.health["inaccurate" if statuses[i] == STATUS_INACCURATE
+                         else "clean"] += 1
+    if fail_idx:
+        _escalate(items, fail_idx, xs, objs, ok, diags, statuses,
+                  backend, solver_opts, key, cache)
     return xs, objs, ok, diags
+
+
+def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
+              solver_opts, key, cache) -> None:
+    """Escalation ladder for a group's failed members (mutates the result
+    lists in place).
+
+    Rung 1 — boosted-budget retry: members whose exit was NOT a certified
+    infeasibility re-solve with ``LADDER_ITER_BOOST``x ``max_iters`` and a
+    relaxed ``inaccurate_factor``; only the failed members are in the
+    batch, and the retry solver clones the cached base solver's
+    preconditioning.  Rung 2 — exact CPU fallback: survivors (and
+    certified-infeasible members, whose first-order certificate deserves
+    an exact second opinion) solve on HiGHS one by one — the
+    generalization of the MILP-rescue pattern to all windows.  Members
+    failing both rungs keep their diagnosis for the case quarantine in
+    ``apply_subgroup``.  Binary (integral) windows on an accelerated
+    backend are excluded: their relaxation failures already re-solve on
+    the exact CPU MILP in ``apply_subgroup``.  On the cpu backend with no
+    fault plan the ladder short-circuits entirely — the exact solver is
+    deterministic, so re-solving cannot recover anything."""
+    from ..ops.pdhg import STATUS_ITER_LIMIT, STATUS_PRIMAL_INFEASIBLE, \
+        PDHGOptions
+    import dataclasses
+    plan = faultinject.get_plan()
+    t0 = time.perf_counter()
+    fail_idx = [i for i in fail_idx
+                if backend == "cpu" or items[i][2].integrality is None]
+    if not fail_idx:
+        return
+    if backend == "cpu" and plan is None:
+        # the exact CPU path is deterministic: re-solving the identical
+        # HiGHS instance (boosted PDHG options never reach it) cannot
+        # change the outcome, so a real cpu-backend failure goes straight
+        # to quarantine.  A fault plan keeps the rungs reachable — the
+        # injected failures it flips ARE recoverable re-solves.
+        return
+    # ---- rung 1: boosted-budget retry of the failed members only ----
+    retry_idx = [i for i in fail_idx
+                 if statuses[i] != STATUS_PRIMAL_INFEASIBLE]
+    if retry_idx:
+        base = solver_opts or PDHGOptions()
+        boosted = dataclasses.replace(
+            base, max_iters=base.max_iters * LADDER_ITER_BOOST,
+            inaccurate_factor=base.inaccurate_factor
+            * LADDER_INACCURATE_RELAX)
+        sub_lps = [items[i][2] for i in retry_idx]
+        sub_labels = [items[i][1].label for i in retry_idx]
+        rkey = ("retry", key) if key is not None and cache is not None \
+            else None
+        TellUser.info(
+            f"escalation: re-solving {len(retry_idx)} non-converged "
+            f"window(s) {sub_labels} with {LADDER_ITER_BOOST}x iteration "
+            "budget")
+        rxs, robjs, rok, rdiags, rstatuses = solve_group(
+            sub_lps[0], sub_lps, backend, boosted, key=rkey, cache=cache,
+            labels=sub_labels)
+        for j, i in enumerate(retry_idx):
+            label = items[i][1].label
+            if rok[j] and plan is not None and plan.force_nonconverge(
+                    label, faultinject.RUNG_RETRY):
+                rok[j] = False
+                rstatuses[j] = STATUS_ITER_LIMIT
+                rdiags[j] = ("fault injection: forced non-convergence at "
+                             "rung 'retry'")
+            if rok[j]:
+                xs[i], objs[i], ok[i] = rxs[j], robjs[j], True
+                diags[i], statuses[i] = rdiags[j], rstatuses[j]
+                # health buckets are disjoint final outcomes: a window
+                # counts "retried" only when rung 1 is where it landed
+                with _health_lock:
+                    items[i][0].health["retried"] += 1
+                TellUser.info(f"window {label} recovered on the "
+                              "boosted-budget retry")
+            else:
+                # carry the retry's (possibly changed) verdict into rung 2
+                diags[i], statuses[i] = rdiags[j], rstatuses[j]
+    # ---- rung 2: exact CPU fallback, one member at a time ----
+    for i in [i for i in fail_idx if not ok[i]]:
+        s, ctx, lp = items[i]
+        if plan is not None and plan.cpu_should_fail(ctx.label):
+            diags[i] = (f"{diags[i]}; fault injection: CPU fallback "
+                        "forced to fail")
+            continue
+        if backend == "cpu" and statuses[i] == STATUS_PRIMAL_INFEASIBLE:
+            continue      # HiGHS already certified it exactly
+        res = cpu_ref.solve_lp_cpu(lp)
+        if res.status == 0 and np.isfinite(res.obj):
+            xs[i], objs[i], ok[i] = res.x, res.obj, True
+            with _health_lock:
+                s.health["cpu_fallback"] += 1
+            TellUser.info(f"window {ctx.label} rescued on the exact CPU "
+                          "fallback")
+        elif statuses[i] != STATUS_PRIMAL_INFEASIBLE:
+            # keep the richer dual-ray diagnosis when PDHG certified
+            # infeasibility; otherwise HiGHS's verdict is the better one
+            diags[i] = res.message or diags[i]
+    # ladder wall time is attributed proportionally to each involved
+    # case's failed-member count: the per-case values then SUM to the real
+    # elapsed time, so the run report's aggregate is not inflated by the
+    # number of cases sharing one batched ladder
+    elapsed = time.perf_counter() - t0
+    shares: Dict[int, list] = {}
+    for i in fail_idx:
+        s = items[i][0]
+        shares.setdefault(id(s), [s, 0])[1] += 1
+    with _health_lock:
+        for s, n in shares.values():
+            s.health["retry_seconds"] += elapsed * n / len(fail_idx)
 
 
 def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
@@ -922,10 +1296,9 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
     phase_lock = threading.Lock()    # solve_only runs in pool workers
 
     def solve_only(key, items):
-        lps = [lp for (_, _, lp) in items]
         t0 = time.perf_counter()
-        out = items, solve_group(lps[0], lps, backend, solver_opts,
-                                 key=key, cache=cache)
+        out = items, resolve_group(items, backend, solver_opts,
+                                   key=key, cache=cache)
         dt_ = time.perf_counter() - t0
         with phase_lock:
             phase_acc["solve_s"] += dt_
@@ -958,12 +1331,18 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
         templates: Dict[object, LP] = {}
         items = []
         for s, ctx in members:
+            if s.quarantine is not None:    # case failed in an earlier group
+                continue
             lp = s.build_window_lp(ctx, s._annuity_scalar, s._requirements,
                                    template=templates.get(ctx.label))
             if ctx.label not in templates:
                 templates[ctx.label] = lp
             items.append((s, ctx, lp))
         phase_acc["assembly_s"] += time.perf_counter() - t0
+        # pre-dispatch input guards: poisoned members quarantine their
+        # case here, with a window-labeled diagnostic, instead of burning
+        # a device budget on NaN data
+        items = guard_items(items)
         subgroups: Dict[tuple, list] = {}
         for item in items:
             k = MicrogridScenario._structure_key(item[2])
@@ -1039,15 +1418,19 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
         for s, key, ctx, lp in ready:
             step_groups.setdefault(key, []).append((s, ctx, lp))
         for key, items in step_groups.items():
-            lps = [lp for (_, _, lp) in items]
-            xs, objs, ok, diags = solve_group(lps[0], lps, backend,
-                                              solver_opts,
-                                              key=key, cache=cache)
+            items = guard_items(items)
+            if not items:
+                continue
+            xs, objs, ok, diags = resolve_group(items, backend, solver_opts,
+                                                key=key, cache=cache)
             for (s, ctx, lp), x, o, k, dg in zip(items, xs, objs, ok, diags):
                 s.apply_subgroup([(ctx, lp)], [x], [o], [k], [dg], backend)
+                if s.quarantine is not None:
+                    continue      # ladder exhausted: stop stepping the case
                 s._replay_degradation(ctx)
                 s._deg_pos += 1
-        deg = [s for s in deg if s._deg_pos < len(s._pending)]
+        deg = [s for s in deg
+               if s.quarantine is None and s._deg_pos < len(s._pending)]
 
     for s in scenarios:
         # observable for the solver cache: a degradation year must show
@@ -1064,3 +1447,31 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
             exact_keys_by_case.get(id(s), ()))
         s.solve_metadata["dispatch_groups_total"] = len(exact_keys_all)
         s.finish_dispatch()
+
+    # case-level failure isolation: quarantined cases were dropped from
+    # the sweep as they failed; the run as a whole aborts ONLY when no
+    # case survived, with every case's diagnosis aggregated.  The gate
+    # counts scenarios, not dict keys: caller-supplied case ids may
+    # collide, and a collision must not suppress the abort or drop a
+    # diagnosis from the aggregate.
+    n_quarantined = sum(1 for s in scenarios if s.quarantine is not None)
+    failures: Dict[Any, str] = {}
+    for i, s in enumerate(scenarios):
+        if s.quarantine is None:
+            continue
+        cid = s.case.case_id
+        failures[cid if cid not in failures else f"{cid}#{i}"] = \
+            s.quarantine["reason"]
+    if n_quarantined and n_quarantined == len(scenarios):
+        # total failure aborts before the caller's post-run reporting —
+        # log the health report here so the audit trail still exists
+        from ..io.summary import log_health_report, run_health_report
+        log_health_report(run_health_report(
+            {i: s.health for i, s in enumerate(scenarios)},
+            {i: s.quarantine for i, s in enumerate(scenarios)}))
+        raise AggregatedSolverError(failures)
+    if n_quarantined:
+        TellUser.warning(
+            f"{n_quarantined} of {len(scenarios)} case(s) quarantined "
+            f"(case ids {sorted(str(k) for k in failures)}); the "
+            "remaining cases completed — see the run-health report")

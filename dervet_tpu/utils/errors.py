@@ -26,6 +26,20 @@ class SolverError(Exception):
     """Dispatch optimization failed (non-convergence / infeasibility)."""
 
 
+class AggregatedSolverError(SolverError):
+    """Every case of a dispatch failed.  Individual case failures are
+    quarantined (the sweep continues without them); only when no case
+    survives does the run abort, carrying each case's diagnosis."""
+
+    def __init__(self, failures):
+        self.failures = dict(failures)     # case id -> diagnosis
+        lines = [f"  case {cid}: {reason}"
+                 for cid, reason in self.failures.items()]
+        super().__init__(
+            f"all {len(self.failures)} case(s) failed dispatch:\n"
+            + "\n".join(lines))
+
+
 class TariffError(Exception):
     """Customer tariff missing or malformed."""
 

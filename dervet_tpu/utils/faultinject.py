@@ -1,0 +1,149 @@
+"""Deterministic fault injection for the solver resilience layer.
+
+PDLP-family first-order solvers have heavy-tailed iteration counts on
+ill-conditioned instances (PAPERS.md: MPAX; DuaLip), so the dispatch loop
+treats non-convergence as an expected operating condition and recovers
+through an escalation ladder (scenario.resolve_group): boosted-budget
+retry -> exact CPU fallback -> case quarantine.  Recovery code that only
+runs on rare hardware/numerical events is effectively untested — this
+module lets tests (and operators debugging a sweep) FORCE a failure at
+each rung deterministically, so every recovery path is exercised rather
+than trusted.
+
+Two activation paths:
+
+* context manager (tests)::
+
+      with faultinject.inject(nonconverge={1}, rungs={"solve", "retry"}):
+          scenario.optimize_problem_loop(backend="cpu")
+
+* environment variables (whole-process, e.g. a driver run)::
+
+      DERVET_TPU_FAULT_NONCONVERGE=3,7   force windows 3 and 7 to report
+                                         non-convergence ('all' matches
+                                         every window)
+      DERVET_TPU_FAULT_RUNGS=solve,retry rungs at which the forced
+                                         non-convergence applies
+                                         (default: solve)
+      DERVET_TPU_FAULT_POISON_CASE=2     poison case 2's assembled inputs
+                                         with NaN before dispatch
+      DERVET_TPU_FAULT_CPU_FAIL=3        make the exact-CPU fallback rung
+                                         itself report failure for these
+                                         windows ('all' for every window)
+
+Faults are observational flips and input corruptions only — the injector
+never touches solver internals, so the production code path under test is
+exactly the path a real failure takes.  When no knob is set every hook is
+a cheap no-op.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+# ladder rung names (also recorded in FaultPlan.fired)
+RUNG_SOLVE = "solve"       # the initial (batched) group solve
+RUNG_RETRY = "retry"       # the boosted-budget re-solve of failed members
+RUNG_CPU = "cpu"           # the exact CPU fallback
+EVENT_POISON = "poison"    # input poisoning of a case
+
+
+def _norm(values) -> frozenset:
+    """Normalize labels/case ids to a set of strings ('all'/'*' matches
+    everything)."""
+    if values is None:
+        return frozenset()
+    if isinstance(values, str):
+        values = [v for v in values.split(",") if v.strip()]
+    return frozenset(str(v).strip() for v in values)
+
+
+def _match(targets: frozenset, value) -> bool:
+    if not targets:
+        return False
+    return "all" in targets or "*" in targets or str(value) in targets
+
+
+class FaultPlan:
+    """One configured set of faults; records every fired event so tests
+    can assert the rungs executed in order."""
+
+    def __init__(self, nonconverge: Iterable = (), rungs: Iterable = (RUNG_SOLVE,),
+                 poison_cases: Iterable = (), cpu_fail: Iterable = ()):
+        self.nonconverge = _norm(nonconverge)
+        self.rungs = _norm(rungs)
+        self.poison_cases = _norm(poison_cases)
+        self.cpu_fail = _norm(cpu_fail)
+        self.fired: List[Tuple[str, str]] = []   # (rung/event, label/case)
+
+    def force_nonconverge(self, label, rung: str) -> bool:
+        """Should the solve of window ``label`` at ``rung`` be reported as
+        non-converged?"""
+        if rung in self.rungs and _match(self.nonconverge, label):
+            self.fired.append((rung, str(label)))
+            return True
+        return False
+
+    def should_poison(self, case_id) -> bool:
+        if _match(self.poison_cases, case_id):
+            self.fired.append((EVENT_POISON, str(case_id)))
+            return True
+        return False
+
+    def cpu_should_fail(self, label) -> bool:
+        if _match(self.cpu_fail, label):
+            self.fired.append((RUNG_CPU, str(label)))
+            return True
+        return False
+
+
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def _plan_from_env() -> Optional[FaultPlan]:
+    nc = os.environ.get("DERVET_TPU_FAULT_NONCONVERGE")
+    pc = os.environ.get("DERVET_TPU_FAULT_POISON_CASE")
+    cf = os.environ.get("DERVET_TPU_FAULT_CPU_FAIL")
+    if not (nc or pc or cf):
+        return None
+    rungs = os.environ.get("DERVET_TPU_FAULT_RUNGS", RUNG_SOLVE)
+    return FaultPlan(nonconverge=nc or (), rungs=rungs,
+                     poison_cases=pc or (), cpu_fail=cf or ())
+
+
+def get_plan() -> Optional[FaultPlan]:
+    """The active fault plan: the innermost ``inject()`` context if one is
+    open, else one parsed from the environment, else None (the normal,
+    zero-overhead case)."""
+    if _ACTIVE is not None:
+        return _ACTIVE
+    return _plan_from_env()
+
+
+@contextlib.contextmanager
+def inject(**kwargs):
+    """Install a :class:`FaultPlan` for the duration of the block and yield
+    it (its ``fired`` log lets tests assert rung ordering)."""
+    global _ACTIVE
+    plan = FaultPlan(**kwargs)
+    prev = _ACTIVE
+    _ACTIVE = plan
+    try:
+        yield plan
+    finally:
+        _ACTIVE = prev
+
+
+def maybe_poison(case_id, lp) -> bool:
+    """If ``case_id`` is targeted, corrupt the assembled LP's cost vector
+    with NaN (in place) — exercising the pre-dispatch input guards exactly
+    as corrupted upstream data would."""
+    plan = get_plan()
+    if plan is None or not plan.should_poison(case_id):
+        return False
+    c = np.asarray(lp.c)
+    c[: max(1, c.shape[0] // 16)] = np.nan
+    return True

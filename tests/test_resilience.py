@@ -1,0 +1,390 @@
+"""Solver resilience layer: escalation ladder, case-level failure
+isolation, pre-dispatch input guards, and the run-health report — every
+recovery rung exercised deterministically through the fault-injection
+harness (``dervet_tpu.utils.faultinject``) rather than trusted.
+
+The reference tool's per-window solve either returns optimal or kills the
+run; the batched dispatch loop instead treats first-order non-convergence
+as an expected operating condition (PDLP-family solvers have heavy-tailed
+iteration counts, PAPERS.md: MPAX) and degrades gracefully."""
+import logging
+
+import numpy as np
+import pytest
+
+from dervet_tpu.benchlib import synthetic_case
+from dervet_tpu.scenario.scenario import (MicrogridScenario, resolve_group,
+                                          run_dispatch, solve_group,
+                                          validate_lp_inputs)
+from dervet_tpu.utils import faultinject
+from dervet_tpu.utils.errors import AggregatedSolverError, SolverError
+
+
+def _small_case(case_id: int = 0, days: int = 2, infeasible: bool = False):
+    """Two days of the synthetic Battery+PV+DA case in 12-hour windows
+    (4 small window-LPs) — fast enough for per-rung fault drills."""
+    case = synthetic_case()
+    case.case_id = case_id
+    case.scenario["allow_partial_year"] = True
+    case.scenario["n"] = 12
+    ts = case.datasets.time_series.iloc[: 24 * days].copy()
+    if infeasible:
+        # an aggregate energy floor far above the battery's capacity for
+        # two hours of window 1: genuinely primal infeasible
+        case.streams["User"] = {"price": 0.0}
+        floor = np.zeros(len(ts))
+        floor[14:16] = 1e6
+        ts["Aggregate Energy Min (kWh)"] = floor
+    case.datasets.time_series = ts
+    return case
+
+
+class TestEscalationLadder:
+    def test_retry_rung_recovers(self):
+        """A window forced non-converged at the initial solve recovers on
+        the boosted-budget retry; the run completes with the same
+        objectives as an uninjected run."""
+        ref = MicrogridScenario(_small_case())
+        ref.optimize_problem_loop(backend="cpu")
+        with faultinject.inject(nonconverge={1}) as plan:
+            s = MicrogridScenario(_small_case())
+            s.optimize_problem_loop(backend="cpu")
+        assert plan.fired == [("solve", "1")]
+        assert s.quarantine is None
+        assert s.health["retried"] == 1
+        assert s.health["clean"] == len(s.windows) - 1
+        assert s.health["cpu_fallback"] == 0
+        assert s.health["retry_seconds"] > 0
+        assert set(s.objective_values) == set(ref.objective_values)
+        for k in ref.objective_values:
+            assert s.objective_values[k]["Total Objective"] == \
+                pytest.approx(ref.objective_values[k]["Total Objective"],
+                              rel=1e-9)
+
+    def test_cpu_fallback_rung(self):
+        """Forced non-convergence at BOTH the initial solve and the retry
+        drops the window to the exact CPU fallback; rungs fire in ladder
+        order and the case still completes."""
+        with faultinject.inject(nonconverge={1},
+                                rungs={"solve", "retry"}) as plan:
+            s = MicrogridScenario(_small_case())
+            s.optimize_problem_loop(backend="cpu")
+        assert plan.fired == [("solve", "1"), ("retry", "1")]
+        assert s.quarantine is None
+        # health buckets are disjoint final outcomes: the window landed on
+        # the CPU fallback, so it is NOT also counted as retried (the retry
+        # rung's firing is asserted through plan.fired above)
+        assert s.health["retried"] == 0
+        assert s.health["cpu_fallback"] == 1
+        assert len(s.objective_values) == len(s.windows)
+
+    def test_ladder_exhaustion_quarantines(self):
+        """When the CPU fallback itself fails the ladder is exhausted: the
+        case is quarantined with the window named, and the (single-case)
+        run raises ONE aggregated SolverError at the end."""
+        with faultinject.inject(nonconverge={1}, rungs={"solve", "retry"},
+                                cpu_fail={1}) as plan:
+            s = MicrogridScenario(_small_case())
+            with pytest.raises(AggregatedSolverError) as ei:
+                s.optimize_problem_loop(backend="cpu")
+        # every rung fired, in escalation order
+        assert plan.fired == [("solve", "1"), ("retry", "1"), ("cpu", "1")]
+        assert isinstance(ei.value, SolverError)
+        assert s.quarantine is not None and s.quarantine["window"] == 1
+        assert s.health["quarantined"] == 1
+        assert "window 1" in str(ei.value)
+
+    def test_ladder_on_jax_backend(self):
+        """The same ladder drives the batched PDHG path: a member forced
+        non-converged re-solves alone (not the whole group) and the run
+        completes."""
+        with faultinject.inject(nonconverge={2}) as plan:
+            s = MicrogridScenario(_small_case())
+            s.optimize_problem_loop(backend="jax")
+        assert plan.fired == [("solve", "2")]
+        assert s.quarantine is None
+        assert s.health["retried"] == 1
+        assert len(s.objective_values) == len(s.windows)
+
+
+class TestCaseIsolation:
+    def test_one_infeasible_case_does_not_kill_the_sweep(self):
+        """Acceptance drill: a 4-case sweep with one deliberately
+        infeasible case completes the other 3 and emits a health report
+        counting the quarantined case — no full-run abort."""
+        from dervet_tpu.io.summary import run_health_report
+        scens = [MicrogridScenario(_small_case(i, infeasible=(i == 2)))
+                 for i in range(4)]
+        run_dispatch(scens, backend="cpu")     # must not raise
+        for i, s in enumerate(scens):
+            if i == 2:
+                assert s.quarantine is not None
+                assert "nfeasible" in s.quarantine["reason"]
+                assert s.quarantine["window"] == 1
+            else:
+                assert s.quarantine is None
+                assert len(s.objective_values) == len(s.windows)
+        report = run_health_report(
+            {i: s.health for i, s in enumerate(scens)},
+            {i: s.quarantine for i, s in enumerate(scens)
+             if s.quarantine is not None})
+        assert report["cases_quarantined"] == ["2"]
+        assert report["windows"]["quarantined"] == 1
+        # the infeasible case's other windows still solved (and were
+        # checkpoint-eligible); the three healthy cases are fully clean
+        assert report["windows"]["clean"] == 3 * 4 + 3
+
+    def test_all_cases_failed_raises_aggregated(self):
+        scens = [MicrogridScenario(_small_case(i, infeasible=True))
+                 for i in range(2)]
+        with pytest.raises(AggregatedSolverError) as ei:
+            run_dispatch(scens, backend="cpu")
+        assert set(ei.value.failures) == {0, 1}
+        assert all("nfeasible" in r for r in ei.value.failures.values())
+
+    def test_all_failed_duplicate_case_ids_still_abort(self):
+        """Caller-supplied case ids may collide — the all-failed abort
+        counts scenarios, not unique ids, and keeps every diagnosis."""
+        scens = [MicrogridScenario(_small_case(0, infeasible=True))
+                 for _ in range(2)]
+        with pytest.raises(AggregatedSolverError) as ei:
+            run_dispatch(scens, backend="cpu")
+        assert len(ei.value.failures) == 2
+
+    def test_checkpoint_flushed_before_quarantine(self, tmp_path):
+        """A case leaving the dispatch mid-run persists its already-solved
+        windows first: the resumed run (fault cleared) re-solves ONLY the
+        failed window."""
+        with faultinject.inject(nonconverge={2}, rungs={"solve", "retry"},
+                                cpu_fail={2}):
+            s = MicrogridScenario(_small_case())
+            with pytest.raises(SolverError):
+                s.optimize_problem_loop(backend="cpu",
+                                        checkpoint_dir=tmp_path)
+        assert s._checkpoint_path(tmp_path).exists()
+        s2 = MicrogridScenario(_small_case())
+        s2.optimize_problem_loop(backend="cpu", checkpoint_dir=tmp_path)
+        assert s2.quarantine is None
+        assert len(s2.objective_values) == len(s2.windows)
+        # windows 0/1/3 resumed from the flushed checkpoint; only the
+        # previously-failed window 2 solved fresh
+        assert s2.health["clean"] == 1
+
+
+class TestInputGuards:
+    def test_poisoned_case_quarantined_others_complete(self):
+        with faultinject.inject(poison_cases={1}) as plan:
+            a = MicrogridScenario(_small_case(0))
+            b = MicrogridScenario(_small_case(1))
+            run_dispatch([a, b], backend="cpu")
+        assert ("poison", "1") in plan.fired
+        assert a.quarantine is None
+        assert len(a.objective_values) == len(a.windows)
+        assert b.quarantine is not None
+        assert "non-finite" in b.quarantine["reason"]
+        assert "window" in b.quarantine["reason"]    # window-labeled
+        assert b.health["quarantined"] == 1
+        # the poisoned case's never-dispatched remainder is accounted, so
+        # its buckets still sum to its window count
+        assert b.health["quarantined"] + b.health["skipped"] + \
+            b.health["clean"] == len(b.windows)
+
+    def test_validate_rejects_nan_inf_and_crossed_bounds(self):
+        s = MicrogridScenario(_small_case())
+        lp = s.build_window_lp(s.windows[0])
+        assert validate_lp_inputs(lp, 0) is None
+        lp.c[3] = np.nan
+        msg = validate_lp_inputs(lp, 7)
+        assert msg is not None and "window 7" in msg and "c (costs)" in msg
+        lp.c[3] = 0.0
+        lp.q[0] = np.inf
+        msg = validate_lp_inputs(lp, 7)
+        assert msg is not None and "q (constraint rhs)" in msg
+        lp.q[0] = 0.0
+        lp.l[5] = 10.0
+        lp.u[5] = 1.0
+        msg = validate_lp_inputs(lp, 7)
+        assert msg is not None and "crossed bound" in msg
+        lp.l[5] = np.nan
+        msg = validate_lp_inputs(lp, 7)
+        assert msg is not None and "NaN in bound" in msg
+
+    def test_rejection_happens_before_dispatch(self, monkeypatch):
+        """The guard fires pre-dispatch: the solver is never entered for a
+        poisoned case."""
+        import dervet_tpu.scenario.scenario as scn
+        calls = []
+        real = scn.solve_group
+
+        def counting(lp0, lps, backend, opts, **kw):
+            calls.append(len(lps))
+            return real(lp0, lps, backend, opts, **kw)
+
+        monkeypatch.setattr(scn, "solve_group", counting)
+        with faultinject.inject(poison_cases={0}):
+            s = MicrogridScenario(_small_case(0))
+            with pytest.raises(SolverError):
+                s.optimize_problem_loop(backend="cpu")
+        assert calls == []      # nothing reached the solver
+
+
+class TestDiagnostics:
+    def _arb_lp(self, T=48):
+        """Small battery-arbitrage LP (same block structure the dispatch
+        engine emits)."""
+        from dervet_tpu.ops import LPBuilder
+        rng = np.random.default_rng(1)
+        price = rng.uniform(10, 80, T) / 1000
+        b = LPBuilder()
+        ch = b.var("ch", T, 0.0, 250.0)
+        dis = b.var("dis", T, 0.0, 250.0)
+        ene = b.var("ene", T, 0.0, 1000.0)
+        D = np.eye(T) - np.eye(T, k=-1)
+        rhs = np.zeros(T)
+        rhs[0] = 500.0
+        b.add_rows("soe", [(ene, D), (ch, -0.85), (dis, 1.0)], "eq", rhs)
+        b.add_cost(ch, price)
+        b.add_cost(dis, -price)
+        return b.build()
+
+    def test_inaccurate_warning_names_window_and_residual(self, caplog):
+        """STATUS_INACCURATE acceptance names the window label and the
+        actual KKT residuals — an anonymous warning is unactionable at
+        hundreds of batched windows."""
+        from dervet_tpu.ops.pdhg import PDHGOptions
+        lp = self._arb_lp()
+        # a tiny budget against near-zero tolerances cannot converge, but
+        # an enormous inaccurate_factor accepts the exit as INACCURATE
+        opts = PDHGOptions(max_iters=512, eps_abs=1e-15, eps_rel=1e-12,
+                           inaccurate_factor=1e12, pallas_chunk=False,
+                           cpu_rescue_after=None)
+        with caplog.at_level(logging.WARNING, logger="dervet_tpu"):
+            xs, objs, ok, diags, statuses = solve_group(
+                lp, [lp], "jax", opts, labels=[42])
+        assert ok == [True]
+        msgs = [r.message for r in caplog.records
+                if "reduced accuracy" in r.message]
+        assert msgs, caplog.records
+        assert "window 42" in msgs[0]
+        assert "residual" in msgs[0] and "e-" in msgs[0] or "e+" in msgs[0]
+
+    def test_status_specific_diags(self):
+        """Each failure status carries its own message: an iteration-limit
+        exit must not be labeled as anything else, and unknown codes are
+        surfaced as such (the old fallback labeled EVERY non-infeasible
+        failure 'iteration limit')."""
+        from dervet_tpu.ops.pdhg import (STATUS_CONVERGED, STATUS_INACCURATE,
+                                         STATUS_ITER_LIMIT,
+                                         STATUS_PRIMAL_INFEASIBLE,
+                                         PDHGOptions, status_message)
+        seen = {status_message(s) for s in
+                (STATUS_CONVERGED, STATUS_ITER_LIMIT,
+                 STATUS_PRIMAL_INFEASIBLE, STATUS_INACCURATE)}
+        assert len(seen) == 4          # all distinct
+        assert "iteration limit" in status_message(STATUS_ITER_LIMIT)
+        assert "reduced accuracy" in status_message(STATUS_INACCURATE)
+        assert "status 99" in status_message(99)
+        # a genuine iteration-limit exit reports exactly that
+        lp = self._arb_lp()
+        opts = PDHGOptions(max_iters=256, eps_abs=1e-15, eps_rel=1e-12,
+                           inaccurate_factor=1.0, pallas_chunk=False,
+                           cpu_rescue_after=None)
+        xs, objs, ok, diags, statuses = solve_group(lp, [lp], "jax", opts,
+                                                    labels=[0])
+        assert ok == [False]
+        assert statuses == [STATUS_ITER_LIMIT]
+        assert diags[0] == status_message(STATUS_ITER_LIMIT)
+
+    def test_resolve_group_rescues_genuine_iteration_limit(self):
+        """No fault injection: a REAL iteration-limit exit (budget too
+        small for the tolerance) climbs the real ladder and lands on the
+        exact CPU fallback with a correct objective."""
+        from dervet_tpu.ops.cpu_ref import solve_lp_cpu
+        from dervet_tpu.ops.pdhg import PDHGOptions
+
+        class _Ctx:
+            label = 5
+
+        class _Scn:
+            def __init__(self):
+                self.health = {"clean": 0, "inaccurate": 0, "retried": 0,
+                               "cpu_fallback": 0, "quarantined": 0,
+                               "retry_seconds": 0.0}
+
+            class case:
+                case_id = 0
+
+        lp = self._arb_lp()
+        opts = PDHGOptions(max_iters=64, eps_abs=1e-15, eps_rel=1e-12,
+                           inaccurate_factor=1.0, pallas_chunk=False,
+                           cpu_rescue_after=None)
+        s = _Scn()
+        xs, objs, ok, diags = resolve_group([(s, _Ctx(), lp)], "jax", opts)
+        assert ok == [True]
+        assert s.health["retried"] == 0       # disjoint: rung 1 failed
+        assert s.health["retry_seconds"] > 0  # ...but the ladder ran
+        assert s.health["cpu_fallback"] == 1  # rung 2 rescued it
+        ref = solve_lp_cpu(lp)
+        assert objs[0] == pytest.approx(ref.obj, rel=1e-9)
+
+
+class TestHealthReport:
+    def test_report_shape_and_totals(self):
+        from dervet_tpu.io.summary import run_health_report
+        h0 = {"clean": 10, "inaccurate": 1, "retried": 2, "cpu_fallback": 1,
+              "quarantined": 0, "retry_seconds": 1.5}
+        h1 = {"clean": 11, "inaccurate": 0, "retried": 0, "cpu_fallback": 0,
+              "quarantined": 1, "retry_seconds": 0.25}
+        rep = run_health_report(
+            {0: h0, 1: h1}, {1: {"reason": "boom", "window": 3}})
+        assert rep["windows"] == {"clean": 21, "inaccurate": 1,
+                                  "retried": 2, "cpu_fallback": 1,
+                                  "quarantined": 1, "skipped": 0}
+        assert rep["retry_seconds"] == 1.75
+        assert rep["cases_quarantined"] == ["1"]
+        assert rep["quarantine_reasons"] == {"1": "boom"}
+        assert rep["per_case"]["0"]["clean"] == 10
+
+    def test_health_in_solve_metadata(self):
+        s = MicrogridScenario(_small_case())
+        s.optimize_problem_loop(backend="cpu")
+        h = s.solve_metadata["health"]
+        assert h["clean"] == len(s.windows)
+        assert s.solve_metadata["quarantined"] is None
+
+    def test_run_health_json_written(self, tmp_path):
+        from dervet_tpu.io.summary import run_health_report
+        from dervet_tpu.results.result import Result
+        r = Result({})
+        r.run_health = run_health_report({0: {"clean": 4}}, {})
+        r.save_as_csv(tmp_path)
+        import json
+        data = json.loads((tmp_path / "run_health.json").read_text())
+        assert data["windows"]["clean"] == 4
+
+
+class TestFaultInjectEnv:
+    def test_env_knobs_parse(self, monkeypatch):
+        monkeypatch.setenv("DERVET_TPU_FAULT_NONCONVERGE", "3,7")
+        monkeypatch.setenv("DERVET_TPU_FAULT_RUNGS", "solve,retry")
+        monkeypatch.setenv("DERVET_TPU_FAULT_CPU_FAIL", "all")
+        plan = faultinject.get_plan()
+        assert plan is not None
+        assert plan.force_nonconverge(3, "solve")
+        assert plan.force_nonconverge(7, "retry")
+        assert not plan.force_nonconverge(4, "solve")
+        assert plan.cpu_should_fail(123)      # 'all' wildcard
+        assert not plan.should_poison(0)
+
+    def test_no_env_no_plan(self, monkeypatch):
+        for var in ("DERVET_TPU_FAULT_NONCONVERGE",
+                    "DERVET_TPU_FAULT_POISON_CASE",
+                    "DERVET_TPU_FAULT_CPU_FAIL"):
+            monkeypatch.delenv(var, raising=False)
+        assert faultinject.get_plan() is None
+
+    def test_context_manager_restores(self):
+        assert faultinject.get_plan() is None
+        with faultinject.inject(nonconverge={1}):
+            assert faultinject.get_plan() is not None
+        assert faultinject.get_plan() is None
