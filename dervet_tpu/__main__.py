@@ -37,10 +37,21 @@ def main(argv=None):
                              "(this CLI is always non-interactive)")
     args = parser.parse_args(argv)
 
+    from .utils.errors import PreemptedError
+    from .utils.supervisor import EXIT_PREEMPTED
+
     case = DERVET(args.parameters_filename, verbose=args.verbose,
                   base_path=args.base_path)
-    results = case.solve(backend=args.backend,
-                         checkpoint_dir=args.checkpoint_dir)
+    try:
+        results = case.solve(backend=args.backend,
+                             checkpoint_dir=args.checkpoint_dir)
+    except PreemptedError as e:
+        # distinct exit code (75, EX_TEMPFAIL) so job schedulers can tell
+        # "requeue me" from a real failure; checkpoints + run_manifest.json
+        # were already flushed by the supervisor before this propagated
+        import sys
+        print(f"preempted: {e}", file=sys.stderr)
+        raise SystemExit(EXIT_PREEMPTED)
     results.save_as_csv(args.out)
     return results
 

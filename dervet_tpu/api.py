@@ -113,8 +113,18 @@ class DERVET:
                 f"(threshold {self.AUTO_JAX_MIN_WINDOWS}; pass "
                 "backend='jax'/'cpu' to force)")
         t_solve = time.time()
-        run_dispatch(list(scenarios.values()), backend=backend,
-                     solver_opts=solver_opts, checkpoint_dir=checkpoint_dir)
+        # preemption-safe sweep (utils.supervisor): SIGTERM/SIGINT sets a
+        # stop flag honored at window-batch boundaries — checkpoints and
+        # the sweep-level run_manifest.json flush before PreemptedError
+        # propagates to the caller (the CLI maps it to EXIT_PREEMPTED).
+        # A prior manifest in checkpoint_dir lets fully-done cases skip
+        # dispatch entirely; the supervisor's watchdog
+        # (DERVET_TPU_SOLVE_DEADLINE_S) bounds each device solve.
+        from .utils.supervisor import RunSupervisor
+        with RunSupervisor() as sup:
+            run_dispatch(list(scenarios.values()), backend=backend,
+                         solver_opts=solver_opts,
+                         checkpoint_dir=checkpoint_dir, supervisor=sup)
         t_post = time.time()
         TellUser.debug(f"dispatch ({len(scenarios)} case(s)): "
                        f"{t_post - t_solve:.2f}s")

@@ -46,20 +46,26 @@ def run_health_report(health_by_case: Dict, quarantined: Dict) -> Dict:
     cases dropped by the failure-isolation layer."""
     totals = {k: 0 for k in HEALTH_KEYS}
     retry_s = 0.0
+    watchdog = 0
     for h in health_by_case.values():
         for k in HEALTH_KEYS:
             totals[k] += int(h.get(k, 0))
         retry_s += float(h.get("retry_seconds", 0.0))
+        # event counter, not a disjoint window bucket: a timed-out solve's
+        # windows still land in retried/cpu_fallback/quarantined
+        watchdog += int(h.get("watchdog_timeouts", 0))
     return {
         "windows": totals,
         "retry_seconds": round(retry_s, 3),
+        "watchdog_timeouts": watchdog,
         "cases_total": len(health_by_case),
         "cases_quarantined": sorted(str(k) for k in quarantined),
         "quarantine_reasons": {str(k): (q.get("reason") if
                                         isinstance(q, dict) else str(q))
                                for k, q in quarantined.items()},
         "per_case": {str(k): {kk: h.get(kk, 0) for kk in
-                              HEALTH_KEYS + ("retry_seconds",)}
+                              HEALTH_KEYS + ("retry_seconds",
+                                             "watchdog_timeouts")}
                      for k, h in health_by_case.items()},
     }
 
@@ -74,6 +80,9 @@ def log_health_report(report: Dict) -> None:
            f"{t['quarantined']} quarantined / "
            f"{t['skipped']} skipped window(s); "
            f"escalation wall time {report['retry_seconds']:.3f}s")
+    if report.get("watchdog_timeouts"):
+        msg += (f"; {report['watchdog_timeouts']} solve(s) abandoned at "
+                "the watchdog deadline")
     if report["cases_quarantined"]:
         msg += (f"; quarantined case(s) "
                 f"{', '.join(report['cases_quarantined'])}: "

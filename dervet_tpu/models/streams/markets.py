@@ -71,12 +71,16 @@ class MarketService(ValueStream):
     #: face point, and per-column revenue attribution diverges between
     #: backends — the r4 DEGENERATE_SPLIT carve-out).  A relative tilt of
     #: TIEBREAK_EPS x rank on each service's OPTIMIZATION price makes the
-    #: split unique while perturbing the objective by <= 4e-4 relative;
-    #: reporting (proforma/NPV) always uses the untilted price.  1e-3,
-    #: not 1e-4: the tilt gradient must dominate PDHG's convergence
-    #: tolerance (eps_rel 1e-4) for the iterate to actually land on the
-    #: preferred vertex — at 1e-4 the split still wandered ~1.5% of a
-    #: column's scale (input 008, r5).
+    #: split unique while perturbing each tilted stream's price by at most
+    #: TIEBREAK_EPS x max(rank) = 4e-3 relative (rank 4 = LF); reporting
+    #: (proforma/NPV) always uses the untilted price.  Because the labeled
+    #: per-stream revenue vectors exclude the tilt (it rides as a separate
+    #: unlabeled cost below), the labeled objective components need NOT
+    #: sum to the tilted "Total Objective" — the residual is exactly the
+    #: tilt term.  1e-3, not 1e-4: the tilt gradient must dominate PDHG's
+    #: convergence tolerance (eps_rel 1e-4) for the iterate to actually
+    #: land on the preferred vertex — at 1e-4 the split still wandered
+    #: ~1.5% of a column's scale (input 008, r5).
     TIEBREAK_RANK = {"FR": 1, "SR": 2, "NSR": 3, "LF": 4}
     TIEBREAK_EPS = 1e-3
 

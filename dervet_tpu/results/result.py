@@ -57,15 +57,15 @@ class Result:
         return df
 
     def save_as_csv(self, out_dir=None) -> None:
+        from ..utils.supervisor import atomic_output, atomic_write
         out = Path(out_dir or self.dir_abs_path)
         if self.run_health is not None:
             # persisted next to the output set so a large sweep's solver
             # degradations (retries, CPU fallbacks, quarantined cases) are
             # auditable after the run, not just scrollback
             import json
-            out.mkdir(parents=True, exist_ok=True)
-            (out / "run_health.json").write_text(
-                json.dumps(self.run_health, indent=2))
+            atomic_write(out / "run_health.json",
+                         json.dumps(self.run_health, indent=2))
         for key, inst in self.instances.items():
             label = f"{self.csv_label}{key}" if len(self.instances) > 1 else self.csv_label
             inst.save_as_csv(out, label)
@@ -77,9 +77,8 @@ class Result:
             if df is None:
                 df = self.sensitivity_summary()
             if df is not None:
-                out.mkdir(parents=True, exist_ok=True)
-                df.to_csv(out / "sensitivity_summary.csv",
-                          index_label="Case")
+                with atomic_output(out / "sensitivity_summary.csv") as tmp:
+                    df.to_csv(tmp, index_label="Case")
 
 
 class CaseResult:
@@ -144,11 +143,16 @@ class CaseResult:
             cnt = np.bincount(key[valid], minlength=24 * nd)
             with np.errstate(invalid="ignore"):
                 vals = (tot / np.where(cnt, cnt, np.nan)).reshape(24, nd)
-            present = cnt.reshape(24, nd).sum(axis=1) > 0
+            # pivot_table drops index AND column labels with no valid
+            # values: mask all-NaN hours (rows) and all-NaN days (columns)
+            counts = cnt.reshape(24, nd)
+            present = counts.sum(axis=1) > 0
+            day_present = counts.sum(axis=0) > 0
             return pd.DataFrame(
-                vals[present],
+                vals[np.ix_(present, day_present)],
                 index=pd.Index(np.arange(1, 25)[present], name="hour"),
-                columns=pd.Index([d.date() for d in uniq], name="day"))
+                columns=pd.Index([d.date() for d in uniq[day_present]],
+                                 name="day"))
 
         if "Total Load (kW)" in ts.columns:
             load = ts["Total Load (kW)"]
@@ -198,6 +202,7 @@ class CaseResult:
 
     # ------------------------------------------------------------------
     def save_as_csv(self, path: Path, label: str = "") -> None:
+        from ..utils.supervisor import atomic_output
         path.mkdir(parents=True, exist_ok=True)
 
         def put(name, df, index=True, core=False):
@@ -208,7 +213,10 @@ class CaseResult:
             if df is None and core:
                 df = pd.DataFrame()
             if df is not None:
-                df.to_csv(path / f"{name}{label}.csv", index=index)
+                # tmp + fsync + replace: a kill mid-write leaves the
+                # previous complete file, never a truncated CSV
+                with atomic_output(path / f"{name}{label}.csv") as tmp:
+                    df.to_csv(tmp, index=index)
         put("timeseries_results", self.time_series_data, core=True)
         put("technology_summary", self.technology_summary, index=False,
             core=True)

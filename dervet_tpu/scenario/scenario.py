@@ -290,7 +290,13 @@ class MicrogridScenario:
 
     def _checkpoint_fingerprint(self) -> str:
         """Hash of the inputs that determine per-window solutions — a
-        checkpoint from different inputs must be discarded, not resumed."""
+        checkpoint from different inputs must be discarded, not resumed.
+        Memoized: the inputs are fixed at construction, and the manifest
+        consult + checkpoint load would otherwise hash the full time
+        series twice per case."""
+        memo = getattr(self, "_fingerprint_memo", None)
+        if memo is not None:
+            return memo
         import hashlib
         h = hashlib.sha256()
         h.update(repr((str(self.index[0]), str(self.index[-1]),
@@ -304,7 +310,8 @@ class MicrogridScenario:
         if ts is not None:
             h.update(np.ascontiguousarray(
                 ts.to_numpy(dtype=np.float64, na_value=np.nan)).tobytes())
-        return h.hexdigest()
+        self._fingerprint_memo = h.hexdigest()
+        return self._fingerprint_memo
 
     def _load_checkpoint(self, checkpoint_dir, solution):
         """Resume per-window results saved by a previous run (SURVEY §5:
@@ -335,18 +342,17 @@ class MicrogridScenario:
 
     def _save_checkpoint(self, checkpoint_dir, solution, solved_labels):
         import json
-        import os
-        from pathlib import Path
-        Path(checkpoint_dir).mkdir(parents=True, exist_ok=True)
+        from ..utils.supervisor import atomic_output
         path = self._checkpoint_path(checkpoint_dir)
-        tmp = path.with_name(path.stem + "_tmp.npz")
-        np.savez(tmp,
-                 __fingerprint__=self._checkpoint_fingerprint(),
-                 __labels__=np.array(sorted(solved_labels)),
-                 __objectives__=json.dumps(
-                     {str(k): v for k, v in self.objective_values.items()}),
-                 **solution)
-        os.replace(tmp, path)    # atomic: interruption keeps the old file
+        # tmp + fsync + replace: interruption keeps the old file whole
+        with atomic_output(path) as tmp:
+            np.savez(tmp,
+                     __fingerprint__=self._checkpoint_fingerprint(),
+                     __labels__=np.array(sorted(solved_labels)),
+                     __objectives__=json.dumps(
+                         {str(k): v
+                          for k, v in self.objective_values.items()}),
+                     **solution)
 
     # ------------------------------------------------------------------
     # Dispatch runs in phases so that N sensitivity cases can batch their
@@ -439,6 +445,57 @@ class MicrogridScenario:
             self._requirements = self.service_agg.identify_system_requirements(
                 self.ders, self.opt_years, self.index)
         self._pending = list(windows)
+
+    def prepare_resume(self, backend: str, solver_opts=None,
+                       checkpoint_dir=None) -> bool:
+        """Manifest fast path: when a prior run recorded this case as
+        fully ``done``, reload its persisted per-window results and skip
+        the dispatch machinery entirely — no LP assembly, no grouping, no
+        device calls (the per-window checkpoint path merely skipped
+        *windows inside* the case).  Returns False — leaving the case for
+        the normal ``prepare_dispatch`` — whenever the skip cannot be
+        proven sound: sizing cases (frozen sizes are recovered by
+        re-solving the sizing window), degradation-coupled cases (SOH
+        replay needs the windows stepped in order), or a checkpoint that
+        is missing/mismatched/incomplete."""
+        if self.poi.is_sizing_optimization:
+            return False
+        if any(getattr(d, "incl_cycle_degrade", False) for d in self.ders):
+            return False
+        solution: Dict[str, np.ndarray] = {}
+        solved = self._load_checkpoint(checkpoint_dir, solution)
+        if {ctx.label for ctx in self.windows} - set(solved):
+            return False          # incomplete: fall back to dispatch
+        self.sizing_module()
+        # deferral analysis feeds the deferral_results drill-down, not the
+        # dispatch LPs — a resumed case must still produce it or its
+        # output set would differ from an uninterrupted run's
+        deferral = self.streams.get("Deferral")
+        if deferral is not None and deferral.deferral_df is None:
+            deferral.deferral_analysis(self.ders, self.opt_years,
+                                       self.end_year)
+        self._t0 = time.time()
+        self._backend = backend
+        self._solver_opts = solver_opts
+        self._checkpoint_dir = checkpoint_dir
+        self._n_solves = 0
+        self._ckpt_backlog = 0
+        self.quarantine = None
+        self.health = _new_health()
+        self._solution = solution
+        self._solved = solved
+        self._requirements = []
+        self._annuity_scalar = 1.0
+        self._pending = []
+        self._deg_pos = 0
+        self._degrading = []
+        self._resumed_done = True
+        self.solve_metadata["resumed_from_manifest"] = True
+        TellUser.info(
+            f"case {self.case.case_id}: manifest says done — "
+            f"{len(solved)} window result(s) reloaded, case not "
+            "re-dispatched")
+        return True
 
     # id(K) -> (weakref to K, K-bytes digest): template siblings share one
     # K object, so each distinct matrix hashes once per dispatch
@@ -536,7 +593,10 @@ class MicrogridScenario:
 
     def finish_dispatch(self) -> None:
         if self.opt_engine:
-            if self._checkpoint_dir and self._solved:
+            # a manifest-resumed case solved nothing: rewriting an
+            # identical checkpoint would be wasted IO
+            if self._checkpoint_dir and self._solved and \
+                    not getattr(self, "_resumed_done", False):
                 self._save_checkpoint(self._checkpoint_dir, self._solution,
                                       self._solved)
             if self.quarantine is None:
@@ -549,8 +609,9 @@ class MicrogridScenario:
             # restored from a checkpoint are not re-dispatched and are
             # deliberately not counted.)
             if self.quarantine is not None:
-                counted = sum(self.health[k] for k in self.health
-                              if k not in ("skipped", "retry_seconds"))
+                from ..io.summary import HEALTH_KEYS
+                counted = sum(self.health[k] for k in HEALTH_KEYS
+                              if k != "skipped")
                 self.health["skipped"] = max(0,
                                              len(self.windows) - counted)
         self.solve_metadata.update({
@@ -1027,10 +1088,14 @@ def _new_health() -> Dict[str, Any]:
     ends in exactly one bucket (clean / inaccurate-accepted / recovered on
     retry / recovered on the CPU fallback / quarantined / skipped — never
     dispatched because the case quarantined first); ``retry_seconds`` is
-    the case's share of ladder wall time.  The bucket set is
+    the case's share of ladder wall time, and ``watchdog_timeouts`` counts
+    solve attempts abandoned at the deadline (an event counter, NOT a
+    disjoint bucket — a timed-out window still lands in retried /
+    cpu_fallback / quarantined).  The bucket set is
     ``io.summary.HEALTH_KEYS`` so the loop and the report cannot drift."""
     from ..io.summary import HEALTH_KEYS
-    return {**{k: 0 for k in HEALTH_KEYS}, "retry_seconds": 0.0}
+    return {**{k: 0 for k in HEALTH_KEYS}, "retry_seconds": 0.0,
+            "watchdog_timeouts": 0}
 
 
 def _var_name_at(lp: LP, j: int) -> str:
@@ -1093,8 +1158,42 @@ def guard_items(items):
     return out
 
 
+def _count_watchdog_timeout(items, idxs) -> None:
+    """One abandoned solve CALL = one ``watchdog_timeouts`` event per
+    involved case — the counter is documented as an event count, so an
+    8-window batched call that times out must not read as 8 events."""
+    involved = {id(items[i][0]): items[i][0] for i in idxs}
+    with _health_lock:
+        for s in involved.values():
+            s.health["watchdog_timeouts"] += 1
+
+
+def _guarded_solve(watchdog, rung_desc: str, lps, labels, call):
+    """Run one ladder solve under the (optional) watchdog deadline.
+
+    Returns ``((xs, objs, ok, diags, statuses), timed_out)``.  On a
+    timeout the wedged call is abandoned (daemon thread) and every member
+    is synthesized as a non-converged iteration-limit exit whose
+    diagnostic leads with ``watchdog:`` — the marker the escalation
+    ladder keys on to keep re-solving even on the otherwise-deterministic
+    cpu backend (a hung call, unlike a solved-to-infeasible one, may well
+    succeed on a retry)."""
+    from ..ops.pdhg import STATUS_ITER_LIMIT
+    if watchdog is None:
+        return call(), False
+    result, timed_out = watchdog.call(
+        call, f"{rung_desc} solve of window(s) {labels}")
+    if not timed_out:
+        return result, False
+    n = len(lps)
+    diag = (f"watchdog: {rung_desc} solve exceeded the "
+            f"{watchdog.deadline_s:g}s deadline")
+    return ([np.zeros_like(lp.c) for lp in lps], [float("nan")] * n,
+            [False] * n, [diag] * n, [STATUS_ITER_LIMIT] * n), True
+
+
 def resolve_group(items, backend: str, solver_opts, key=None,
-                  cache: Optional[SolverCache] = None):
+                  cache: Optional[SolverCache] = None, watchdog=None):
     """Solve a window group with the per-window escalation ladder.
 
     ``items`` is a list of ``(scenario, ctx, lp)`` (structure-identical
@@ -1105,6 +1204,12 @@ def resolve_group(items, backend: str, solver_opts, key=None,
     after the ladder keep ``ok=False`` and their diagnosis, and the apply
     step quarantines their case.
 
+    ``watchdog`` (a ``supervisor.SolveWatchdog``) bounds every ladder
+    solve with the ``DERVET_TPU_SOLVE_DEADLINE_S`` deadline: a hung call
+    is abandoned, counted in ``health['watchdog_timeouts']``, and the
+    affected members escalate like any other failure instead of stalling
+    the sweep.
+
     Fault injection (utils.faultinject) flips observed convergence here —
     after the real solve, before the ladder — so tests drive every
     recovery rung through the exact production path."""
@@ -1112,9 +1217,18 @@ def resolve_group(items, backend: str, solver_opts, key=None,
         STATUS_ITER_LIMIT
     lps = [lp for (_, _, lp) in items]
     labels = [ctx.label for (_, ctx, _) in items]
-    xs, objs, ok, diags, statuses = solve_group(
-        lps[0], lps, backend, solver_opts, key=key, cache=cache,
-        labels=labels)
+
+    def _call():
+        # hang/slow faults sleep INSIDE the guarded closure, exactly
+        # where a wedged device call would be observed
+        faultinject.maybe_sleep(labels, faultinject.RUNG_SOLVE)
+        return solve_group(lps[0], lps, backend, solver_opts, key=key,
+                           cache=cache, labels=labels)
+
+    (xs, objs, ok, diags, statuses), timed_out = _guarded_solve(
+        watchdog, "initial", lps, labels, _call)
+    if timed_out:
+        _count_watchdog_timeout(items, range(len(items)))
     plan = faultinject.get_plan()
     if plan is not None:
         for i, (s, ctx, lp) in enumerate(items):
@@ -1138,12 +1252,12 @@ def resolve_group(items, backend: str, solver_opts, key=None,
                          else "clean"] += 1
     if fail_idx:
         _escalate(items, fail_idx, xs, objs, ok, diags, statuses,
-                  backend, solver_opts, key, cache)
+                  backend, solver_opts, key, cache, watchdog)
     return xs, objs, ok, diags
 
 
 def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
-              solver_opts, key, cache) -> None:
+              solver_opts, key, cache, watchdog=None) -> None:
     """Escalation ladder for a group's failed members (mutates the result
     lists in place).
 
@@ -1170,12 +1284,15 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
                 if backend == "cpu" or items[i][2].integrality is None]
     if not fail_idx:
         return
-    if backend == "cpu" and plan is None:
+    if backend == "cpu" and plan is None and \
+            not any(str(diags[i]).startswith("watchdog") for i in fail_idx):
         # the exact CPU path is deterministic: re-solving the identical
         # HiGHS instance (boosted PDHG options never reach it) cannot
         # change the outcome, so a real cpu-backend failure goes straight
         # to quarantine.  A fault plan keeps the rungs reachable — the
-        # injected failures it flips ARE recoverable re-solves.
+        # injected failures it flips ARE recoverable re-solves.  Watchdog
+        # timeouts are the other exception: a hung call never produced a
+        # verdict at all, and a re-solve may complete within the deadline.
         return
     # ---- rung 1: boosted-budget retry of the failed members only ----
     retry_idx = [i for i in fail_idx
@@ -1194,9 +1311,16 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
             f"escalation: re-solving {len(retry_idx)} non-converged "
             f"window(s) {sub_labels} with {LADDER_ITER_BOOST}x iteration "
             "budget")
-        rxs, robjs, rok, rdiags, rstatuses = solve_group(
-            sub_lps[0], sub_lps, backend, boosted, key=rkey, cache=cache,
-            labels=sub_labels)
+
+        def _retry_call():
+            faultinject.maybe_sleep(sub_labels, faultinject.RUNG_RETRY)
+            return solve_group(sub_lps[0], sub_lps, backend, boosted,
+                               key=rkey, cache=cache, labels=sub_labels)
+
+        (rxs, robjs, rok, rdiags, rstatuses), r_timed_out = _guarded_solve(
+            watchdog, "retry", sub_lps, sub_labels, _retry_call)
+        if r_timed_out:
+            _count_watchdog_timeout(items, retry_idx)
         for j, i in enumerate(retry_idx):
             label = items[i][1].label
             if rok[j] and plan is not None and plan.force_nonconverge(
@@ -1226,7 +1350,22 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
             continue
         if backend == "cpu" and statuses[i] == STATUS_PRIMAL_INFEASIBLE:
             continue      # HiGHS already certified it exactly
-        res = cpu_ref.solve_lp_cpu(lp)
+
+        def _cpu_call(lp=lp, label=ctx.label):
+            faultinject.maybe_sleep(label, faultinject.RUNG_CPU)
+            return cpu_ref.solve_lp_cpu(lp)
+
+        if watchdog is None:
+            res = _cpu_call()
+        else:
+            res, c_timed_out = watchdog.call(
+                _cpu_call, f"CPU-fallback solve of window {ctx.label}")
+            if c_timed_out:
+                with _health_lock:
+                    s.health["watchdog_timeouts"] += 1
+                diags[i] = (f"{diags[i]}; watchdog: CPU fallback exceeded "
+                            f"the {watchdog.deadline_s:g}s deadline")
+                continue
         if res.status == 0 and np.isfinite(res.obj):
             xs[i], objs[i], ok[i] = res.x, res.obj, True
             with _health_lock:
@@ -1252,16 +1391,99 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
 
 
 def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
-                 checkpoint_dir=None) -> None:
+                 checkpoint_dir=None, supervisor=None) -> None:
     """Dispatch driver over one or many cases (VERDICT r2 #3/#7).
 
     Replaces the reference's serial sensitivity for-loop
     (dervet/DERVET.py:75-83): windows with byte-identical constraint
     structure are batched ACROSS cases into single device calls, and
     degradation-coupled cases — sequential in time — still batch window
-    step t across all cases, carrying each case's own SOH state."""
+    step t across all cases, carrying each case's own SOH state.
+
+    ``supervisor`` (a ``utils.supervisor.RunSupervisor``) makes the sweep
+    preemption-safe: its stop flag (set by SIGTERM/SIGINT) is checked at
+    every window-batch boundary, and a requested stop flushes all case
+    checkpoints plus the sweep-level ``run_manifest.json`` before raising
+    ``PreemptedError``.  With ``checkpoint_dir`` set, a prior manifest is
+    consulted first and fully-``done`` cases (fingerprint-verified) are
+    reloaded instead of re-dispatched.  The supervisor's watchdog (env
+    ``DERVET_TPU_SOLVE_DEADLINE_S``) bounds each ladder solve."""
+    from ..utils.errors import PreemptedError
+    from ..utils import supervisor as _sup
+    watchdog = (supervisor.watchdog if supervisor is not None
+                else _sup.SolveWatchdog.from_env())
+    if watchdog is not None and backend != "cpu":
+        import jax
+        if len(jax.devices()) > 1:
+            # abandoning a sharded call leaves its collectives in flight,
+            # and the retry would launch a SECOND sharded program on the
+            # same device set — which aborts the whole process (see the
+            # multi-device note in the pipeline below).  A disabled
+            # watchdog degrades to pre-PR-2 behavior; a crashed shutdown
+            # loses the checkpoint/manifest flush it exists to protect.
+            TellUser.warning(
+                f"{_sup.DEADLINE_ENV} ignored on a multi-device mesh: "
+                "abandoning an in-flight sharded solve is unsafe there — "
+                "solve watchdog disabled")
+            watchdog = None
+    manifest = _sup.load_manifest(checkpoint_dir) if checkpoint_dir else None
     for s in scenarios:
+        entry = (manifest or {}).get("cases", {}).get(str(s.case.case_id))
+        if entry is not None and entry.get("status") == "done" and \
+                entry.get("fingerprint") == s._checkpoint_fingerprint() and \
+                s.prepare_resume(backend, solver_opts, checkpoint_dir):
+            continue
         s.prepare_dispatch(backend, solver_opts, checkpoint_dir)
+
+    # -- preemption machinery: one counter of applied window batches;
+    # every boundary first gives the fault injector its chance to deliver
+    # a SIGTERM, then honors the supervisor's stop flag
+    _batches_done = [0]
+
+    def _batch_boundary():
+        _batches_done[0] += 1
+        faultinject.maybe_preempt(_batches_done[0])
+        if supervisor is not None and supervisor.stop_requested():
+            raise PreemptedError(
+                f"stop requested (signal {supervisor.stop_signal}) — "
+                f"dispatch halted after {_batches_done[0]} window "
+                "batch(es)")
+
+    try:
+        _dispatch_phases(scenarios, backend, solver_opts, watchdog,
+                         _batch_boundary)
+    except PreemptedError as e:
+        # graceful shutdown: any batched-up checkpoint state is flushed
+        # (only the degradation path batches writes, in strides of 8 —
+        # group solves already persist after every apply, so most cases
+        # need no write here and the shutdown window stays short ahead of
+        # a scheduler's SIGKILL follow-up) and the sweep-level manifest
+        # records done/partial/quarantined per case, so the NEXT run with
+        # this checkpoint_dir resumes instead of restarting.  All writes
+        # are atomic — a second, impatient SIGTERM mid-flush leaves the
+        # previous complete files.
+        if checkpoint_dir:
+            for s in scenarios:
+                if s.opt_engine and s.quarantine is None:
+                    s._flush_checkpoint()
+            _sup.write_manifest(checkpoint_dir, scenarios, backend)
+            TellUser.warning(
+                f"preempted: checkpoints + run manifest flushed to "
+                f"{checkpoint_dir}; re-run with the same checkpoint_dir "
+                "to resume")
+        else:
+            TellUser.warning(
+                "preempted with no checkpoint_dir: nothing could be "
+                "persisted — re-run starts from scratch")
+        raise e
+    _finish_dispatch_bookkeeping(scenarios, backend, checkpoint_dir)
+
+
+def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
+                     _batch_boundary) -> None:
+    """Phases 1 (structure-grouped) and 2 (degradation-stepped) of the
+    batched dispatch; split out of ``run_dispatch`` so the preemption
+    handler wraps exactly the interruptible region."""
 
     # phase 1: all non-degradation windows of all cases, pre-grouped by a
     # CHEAP structural fingerprint (no LP assembly), then — once a group's
@@ -1298,7 +1520,7 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
     def solve_only(key, items):
         t0 = time.perf_counter()
         out = items, resolve_group(items, backend, solver_opts,
-                                   key=key, cache=cache)
+                                   key=key, cache=cache, watchdog=watchdog)
         dt_ = time.perf_counter() - t0
         with phase_lock:
             phase_acc["solve_s"] += dt_
@@ -1356,6 +1578,7 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
             _, members = groups.popitem()
             for k, its in split_exact(members).items():
                 scatter(its, solve_only(k, its)[1])
+                _batch_boundary()
     else:
         # 2-stage pipeline: host LP assembly of group i overlaps the
         # device solve AND the XLA compiles of groups < i (compiles — the
@@ -1399,9 +1622,11 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
                 while len(futs) > max_inflight:
                     items, result = futs.popleft().result()
                     scatter(items, result)
+                    _batch_boundary()
             while futs:
                 items, result = futs.popleft().result()
                 scatter(items, result)
+                _batch_boundary()
 
     # phase 2: degradation-coupled cases, stepped window-by-window with
     # the case axis batched at every step
@@ -1422,13 +1647,15 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
             if not items:
                 continue
             xs, objs, ok, diags = resolve_group(items, backend, solver_opts,
-                                                key=key, cache=cache)
+                                                key=key, cache=cache,
+                                                watchdog=watchdog)
             for (s, ctx, lp), x, o, k, dg in zip(items, xs, objs, ok, diags):
                 s.apply_subgroup([(ctx, lp)], [x], [o], [k], [dg], backend)
                 if s.quarantine is not None:
                     continue      # ladder exhausted: stop stepping the case
                 s._replay_degradation(ctx)
                 s._deg_pos += 1
+            _batch_boundary()
         deg = [s for s in deg
                if s.quarantine is None and s._deg_pos < len(s._pending)]
 
@@ -1447,6 +1674,17 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
             exact_keys_by_case.get(id(s), ()))
         s.solve_metadata["dispatch_groups_total"] = len(exact_keys_all)
         s.finish_dispatch()
+
+
+def _finish_dispatch_bookkeeping(scenarios, backend, checkpoint_dir) -> None:
+    """Post-dispatch sweep bookkeeping: persist the resume manifest, then
+    apply the case-isolation abort policy."""
+    if checkpoint_dir:
+        # the completed sweep's manifest marks every surviving case
+        # ``done`` — the NEXT run with this checkpoint_dir reloads them
+        # without re-dispatching — and keeps quarantined diagnoses
+        from ..utils import supervisor as _sup
+        _sup.write_manifest(checkpoint_dir, scenarios, backend)
 
     # case-level failure isolation: quarantined cases were dropped from
     # the sweep as they failed; the run as a whole aborts ONLY when no

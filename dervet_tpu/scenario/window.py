@@ -121,6 +121,11 @@ def make_windows(index: pd.DatetimeIndex, ts: pd.DataFrame, monthly,
                  n, dt: float) -> List[WindowContext]:
     levels = build_optimization_levels(index, n, dt).to_numpy()
     out = []
+    if len(levels) == 0:
+        # np.all over an empty diff is vacuously True, and the fast path
+        # below would then index levels[0] — an empty index yields no
+        # windows, not an IndexError (ADVICE r5)
+        return out
     if np.all(np.diff(levels) >= 0):
         # labels are consecutive in time (the normal ascending-index
         # case): windows are contiguous slices, and positional slicing

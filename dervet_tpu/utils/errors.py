@@ -40,6 +40,15 @@ class AggregatedSolverError(SolverError):
             + "\n".join(lines))
 
 
+class PreemptedError(Exception):
+    """The run received SIGTERM/SIGINT and shut down gracefully at a
+    window-batch boundary: case checkpoints and the sweep-level
+    ``run_manifest.json`` were flushed first, so a re-run with the same
+    ``checkpoint_dir`` resumes instead of restarting.  The CLI maps this
+    to exit code ``supervisor.EXIT_PREEMPTED`` (75, EX_TEMPFAIL) so job
+    schedulers can tell preemption from failure."""
+
+
 class TariffError(Exception):
     """Customer tariff missing or malformed."""
 

@@ -30,16 +30,32 @@ Two activation paths:
       DERVET_TPU_FAULT_CPU_FAIL=3        make the exact-CPU fallback rung
                                          itself report failure for these
                                          windows ('all' for every window)
+      DERVET_TPU_FAULT_HANG=1            hang the solve of window 1 (sleep
+                                         DERVET_TPU_FAULT_HANG_S, default
+                                         60 s) at the configured rungs —
+                                         exercises the solve watchdog
+      DERVET_TPU_FAULT_SLOW=1            slow the solve of window 1 by
+                                         DERVET_TPU_FAULT_SLOW_S (default
+                                         1 s) at the configured rungs
+      DERVET_TPU_FAULT_PREEMPT_AFTER=2   self-deliver SIGTERM after 2
+                                         window-batch boundaries —
+                                         exercises graceful shutdown +
+                                         the resume manifest (requires a
+                                         RunSupervisor to be installed,
+                                         or the default disposition kills
+                                         the process)
 
-Faults are observational flips and input corruptions only — the injector
-never touches solver internals, so the production code path under test is
-exactly the path a real failure takes.  When no knob is set every hook is
-a cheap no-op.
+Faults are observational flips, input corruptions, delays, and signals
+only — the injector never touches solver internals, so the production
+code path under test is exactly the path a real failure takes.  When no
+knob is set every hook is a cheap no-op.
 """
 from __future__ import annotations
 
 import contextlib
 import os
+import signal
+import time
 from typing import Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -49,6 +65,9 @@ RUNG_SOLVE = "solve"       # the initial (batched) group solve
 RUNG_RETRY = "retry"       # the boosted-budget re-solve of failed members
 RUNG_CPU = "cpu"           # the exact CPU fallback
 EVENT_POISON = "poison"    # input poisoning of a case
+EVENT_HANG = "hang"        # solve call put to sleep past the watchdog
+EVENT_SLOW = "slow_solve"  # solve call delayed (bounded)
+EVENT_PREEMPT = "preempt"  # self-delivered SIGTERM at a batch boundary
 
 
 def _norm(values) -> frozenset:
@@ -72,11 +91,24 @@ class FaultPlan:
     can assert the rungs executed in order."""
 
     def __init__(self, nonconverge: Iterable = (), rungs: Iterable = (RUNG_SOLVE,),
-                 poison_cases: Iterable = (), cpu_fail: Iterable = ()):
+                 poison_cases: Iterable = (), cpu_fail: Iterable = (),
+                 hang: Iterable = (), hang_seconds: float = 60.0,
+                 slow: Iterable = (), slow_seconds: float = 1.0,
+                 preempt_after: Optional[int] = None):
         self.nonconverge = _norm(nonconverge)
         self.rungs = _norm(rungs)
         self.poison_cases = _norm(poison_cases)
         self.cpu_fail = _norm(cpu_fail)
+        # hang/slow target window labels and honor the same ``rungs`` set
+        # as nonconverge, so a hang can be drilled at any ladder rung
+        self.hang = _norm(hang)
+        self.hang_seconds = float(hang_seconds)
+        self.slow = _norm(slow)
+        self.slow_seconds = float(slow_seconds)
+        # preempt: SIGTERM self-delivery after N window-batch boundaries
+        self.preempt_after = (None if preempt_after is None
+                              else int(preempt_after))
+        self._preempt_fired = False
         self.fired: List[Tuple[str, str]] = []   # (rung/event, label/case)
 
     def force_nonconverge(self, label, rung: str) -> bool:
@@ -99,28 +131,81 @@ class FaultPlan:
             return True
         return False
 
+    def sleep_seconds(self, labels, rung: str) -> Tuple[float, str]:
+        """Delay (seconds, event kind) the solve of any of ``labels`` at
+        ``rung`` should suffer — (0, "") when untargeted.  ``hang`` wins
+        over ``slow_solve`` when both match."""
+        if rung not in self.rungs:
+            return 0.0, ""
+        if not isinstance(labels, (list, tuple, set, frozenset)):
+            labels = (labels,)
+        for kind, targets, secs in (
+                (EVENT_HANG, self.hang, self.hang_seconds),
+                (EVENT_SLOW, self.slow, self.slow_seconds)):
+            hit = [lb for lb in labels if _match(targets, lb)]
+            if hit:
+                self.fired.append((kind, str(hit[0])))
+                return secs, kind
+        return 0.0, ""
+
+    def preempt_due(self, batches_done: int) -> bool:
+        if self.preempt_after is None or self._preempt_fired or \
+                batches_done < self.preempt_after:
+            return False
+        self._preempt_fired = True
+        self.fired.append((EVENT_PREEMPT, str(batches_done)))
+        return True
+
 
 _ACTIVE: Optional[FaultPlan] = None
+
+# env-plan memo: faults carry per-plan state (the one-shot preempt latch,
+# the ``fired`` log), so the env path must hand back the SAME plan object
+# across hook calls — rebuilding per call would re-deliver a "one-shot"
+# SIGTERM at every batch boundary.  Keyed on a snapshot of the knob values
+# so tests that monkeypatch the environment still see a fresh plan.
+_ENV_VARS = ("DERVET_TPU_FAULT_NONCONVERGE", "DERVET_TPU_FAULT_POISON_CASE",
+             "DERVET_TPU_FAULT_CPU_FAIL", "DERVET_TPU_FAULT_RUNGS",
+             "DERVET_TPU_FAULT_HANG", "DERVET_TPU_FAULT_HANG_S",
+             "DERVET_TPU_FAULT_SLOW", "DERVET_TPU_FAULT_SLOW_S",
+             "DERVET_TPU_FAULT_PREEMPT_AFTER")
+_ENV_PLAN: Optional[FaultPlan] = None
+_ENV_SNAPSHOT: Optional[tuple] = None
 
 
 def _plan_from_env() -> Optional[FaultPlan]:
     nc = os.environ.get("DERVET_TPU_FAULT_NONCONVERGE")
     pc = os.environ.get("DERVET_TPU_FAULT_POISON_CASE")
     cf = os.environ.get("DERVET_TPU_FAULT_CPU_FAIL")
-    if not (nc or pc or cf):
+    hg = os.environ.get("DERVET_TPU_FAULT_HANG")
+    sl = os.environ.get("DERVET_TPU_FAULT_SLOW")
+    pa = os.environ.get("DERVET_TPU_FAULT_PREEMPT_AFTER")
+    if not (nc or pc or cf or hg or sl or pa):
         return None
     rungs = os.environ.get("DERVET_TPU_FAULT_RUNGS", RUNG_SOLVE)
-    return FaultPlan(nonconverge=nc or (), rungs=rungs,
-                     poison_cases=pc or (), cpu_fail=cf or ())
+    return FaultPlan(
+        nonconverge=nc or (), rungs=rungs,
+        poison_cases=pc or (), cpu_fail=cf or (),
+        hang=hg or (),
+        hang_seconds=float(os.environ.get("DERVET_TPU_FAULT_HANG_S", 60)),
+        slow=sl or (),
+        slow_seconds=float(os.environ.get("DERVET_TPU_FAULT_SLOW_S", 1)),
+        preempt_after=int(pa) if pa else None)
 
 
 def get_plan() -> Optional[FaultPlan]:
     """The active fault plan: the innermost ``inject()`` context if one is
-    open, else one parsed from the environment, else None (the normal,
+    open, else one parsed from the environment (memoized per knob
+    snapshot, so stateful faults stay one-shot), else None (the normal,
     zero-overhead case)."""
+    global _ENV_PLAN, _ENV_SNAPSHOT
     if _ACTIVE is not None:
         return _ACTIVE
-    return _plan_from_env()
+    snap = tuple(os.environ.get(k) for k in _ENV_VARS)
+    if snap != _ENV_SNAPSHOT:
+        _ENV_SNAPSHOT = snap
+        _ENV_PLAN = _plan_from_env()
+    return _ENV_PLAN
 
 
 @contextlib.contextmanager
@@ -146,4 +231,31 @@ def maybe_poison(case_id, lp) -> bool:
         return False
     c = np.asarray(lp.c)
     c[: max(1, c.shape[0] // 16)] = np.nan
+    return True
+
+
+def maybe_sleep(labels, rung: str) -> float:
+    """``hang``/``slow_solve`` injection point, called INSIDE the
+    watchdog-guarded solve closure so a targeted delay is observed
+    exactly where a wedged device call would be.  Returns the seconds
+    slept (0 in the no-plan fast path)."""
+    plan = get_plan()
+    if plan is None:
+        return 0.0
+    secs, kind = plan.sleep_seconds(labels, rung)
+    if secs > 0:
+        time.sleep(secs)
+    return secs
+
+
+def maybe_preempt(batches_done: int) -> bool:
+    """``preempt`` injection point at a window-batch boundary: when due,
+    self-deliver SIGTERM — the exact signal a preemptible-VM reclaim
+    sends — so the supervisor's graceful-shutdown path is exercised
+    end-to-end (stop flag -> checkpoint flush -> manifest -> distinct
+    exit code)."""
+    plan = get_plan()
+    if plan is None or not plan.preempt_due(batches_done):
+        return False
+    os.kill(os.getpid(), signal.SIGTERM)
     return True

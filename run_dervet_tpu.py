@@ -2,6 +2,10 @@
 
 Usage:  python run_dervet_tpu.py <model_parameters.csv> [-v] [--backend auto|jax|cpu]
                                  [--base-path DIR] [--out DIR]
+                                 [--checkpoint-dir DIR]
+
+Exit codes: 0 success, 75 preempted (EX_TEMPFAIL — checkpoints and the
+resume manifest were flushed; re-run with the same --checkpoint-dir).
 """
 import argparse
 import sys
@@ -31,11 +35,25 @@ def main(argv=None):
                              "(default: the parameters file's directory)")
     parser.add_argument("--out", default=None,
                         help="override results output directory")
+    parser.add_argument("--checkpoint-dir", default=None,
+                        help="directory for per-window solve checkpoints and "
+                             "the sweep-level run_manifest.json (resume an "
+                             "interrupted run from here)")
     args = parser.parse_args(argv)
+
+    from dervet_tpu.utils.errors import PreemptedError
+    from dervet_tpu.utils.supervisor import EXIT_PREEMPTED
 
     case = DERVET(args.parameters_filename, verbose=args.verbose,
                   base_path=args.base_path)
-    results = case.solve(backend=args.backend)
+    try:
+        results = case.solve(backend=args.backend,
+                             checkpoint_dir=args.checkpoint_dir)
+    except PreemptedError as e:
+        # EX_TEMPFAIL so job schedulers requeue instead of failing the job;
+        # checkpoints + run_manifest.json were flushed before this raised
+        print(f"preempted: {e}", file=sys.stderr)
+        raise SystemExit(EXIT_PREEMPTED)
     results.save_as_csv(args.out)
     return results
 

@@ -1,0 +1,367 @@
+"""Run supervisor layer: graceful SIGTERM shutdown with a sweep-level
+resume manifest, the solve watchdog, and crash-safe output writes — every
+path exercised deterministically through the fault-injection harness
+(``hang`` / ``slow_solve`` / ``preempt`` fault kinds).
+
+PR 1's resilience ladder covers *solver* failure inside a window; this
+layer covers the *run*: a preempted sweep flushes checkpoints plus
+``run_manifest.json`` and exits with a distinct code, a re-run with the
+same checkpoint_dir skips fully-``done`` cases entirely, and a wedged
+device call is abandoned at the ``DERVET_TPU_SOLVE_DEADLINE_S`` deadline
+instead of stalling the process."""
+import json
+import types
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dervet_tpu.benchlib import synthetic_case
+from dervet_tpu.scenario.scenario import MicrogridScenario, run_dispatch
+from dervet_tpu.utils import faultinject
+from dervet_tpu.utils import supervisor as sup
+from dervet_tpu.utils.errors import PreemptedError
+
+
+def _small_case(case_id: int = 0, days: int = 2, n=12):
+    """Days of the synthetic Battery+PV+DA case in n-hour windows — small
+    enough for per-fault drills (same shape as test_resilience)."""
+    case = synthetic_case()
+    case.case_id = case_id
+    case.scenario["allow_partial_year"] = True
+    case.scenario["n"] = n
+    case.datasets.time_series = \
+        case.datasets.time_series.iloc[: 24 * days].copy()
+    return case
+
+
+# ---------------------------------------------------------------------------
+# Crash-safe writes
+# ---------------------------------------------------------------------------
+
+class TestAtomicWrites:
+    def test_atomic_write_round_trip(self, tmp_path):
+        target = tmp_path / "out" / "health.json"
+        sup.atomic_write(target, '{"ok": 1}')
+        assert json.loads(target.read_text()) == {"ok": 1}
+        # no tmp residue, and the tmp name is dot-prefixed so output-dir
+        # globs can never pick a half-written file up
+        assert [p.name for p in target.parent.iterdir()] == ["health.json"]
+
+    def test_interrupted_write_keeps_previous_file(self, tmp_path):
+        target = tmp_path / "manifest.json"
+        sup.atomic_write(target, "v1")
+        with pytest.raises(RuntimeError):
+            with sup.atomic_output(target) as tmp:
+                tmp.write_text("v2-half-wri")
+                raise RuntimeError("kill mid-write")
+        assert target.read_text() == "v1"          # old file intact
+        assert list(tmp_path.iterdir()) == [target]  # tmp cleaned up
+
+    def test_atomic_output_keeps_suffix_for_savez(self, tmp_path):
+        # np.savez appends .npz when the target lacks it: the tmp must
+        # keep the suffix so the write lands on the intended name
+        target = tmp_path / "case0_windows.npz"
+        with sup.atomic_output(target) as tmp:
+            assert tmp.suffix == ".npz"
+            np.savez(tmp, a=np.arange(3))
+        assert np.array_equal(np.load(target)["a"], np.arange(3))
+
+    def test_bytes_payload(self, tmp_path):
+        sup.atomic_write(tmp_path / "b.bin", b"\x00\x01")
+        assert (tmp_path / "b.bin").read_bytes() == b"\x00\x01"
+
+
+# ---------------------------------------------------------------------------
+# Resume manifest
+# ---------------------------------------------------------------------------
+
+def _fake_scn(cid, total, solved, quarantine=None, opt_engine=True):
+    s = types.SimpleNamespace(
+        case=types.SimpleNamespace(case_id=cid),
+        windows=list(range(total)), _solved=set(range(solved)),
+        quarantine=quarantine, opt_engine=opt_engine)
+    s._checkpoint_fingerprint = lambda: f"fp{cid}"
+    return s
+
+
+class TestManifest:
+    def test_write_statuses(self, tmp_path):
+        scns = [_fake_scn(0, 4, 4),
+                _fake_scn(1, 4, 2),
+                _fake_scn(2, 4, 1, quarantine={"reason": "boom"}),
+                _fake_scn(3, 4, 0, opt_engine=False)]
+        m = sup.write_manifest(tmp_path, scns, backend="cpu")
+        on_disk = json.loads(sup.manifest_path(tmp_path).read_text())
+        assert on_disk == m
+        assert m["version"] == sup.MANIFEST_VERSION
+        assert m["backend"] == "cpu"
+        cases = m["cases"]
+        assert cases["0"]["status"] == "done"
+        assert cases["1"]["status"] == "partial"
+        assert cases["1"]["windows_done"] == 2
+        assert cases["2"]["status"] == "quarantined"
+        assert cases["2"]["reason"] == "boom"
+        assert cases["3"]["status"] == "done"     # no dispatch needed
+        assert cases["0"]["fingerprint"] == "fp0"
+
+    def test_load_missing_corrupt_or_wrong_version(self, tmp_path):
+        assert sup.load_manifest(tmp_path) is None
+        path = sup.manifest_path(tmp_path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text("{ truncated")
+        assert sup.load_manifest(tmp_path) is None
+        path.write_text(json.dumps({"version": 999, "cases": {}}))
+        assert sup.load_manifest(tmp_path) is None
+        path.write_text(json.dumps({"version": sup.MANIFEST_VERSION,
+                                    "cases": {"0": {"status": "done"}}}))
+        m = sup.load_manifest(tmp_path)
+        assert m is not None and m["cases"]["0"]["status"] == "done"
+
+
+# ---------------------------------------------------------------------------
+# Solve watchdog
+# ---------------------------------------------------------------------------
+
+class TestWatchdog:
+    def test_call_fast_slow_and_raising(self):
+        wd = sup.SolveWatchdog(0.25)
+        assert wd.call(lambda: 42) == (42, False)
+        import time as _t
+        result, timed_out = wd.call(lambda: _t.sleep(5))
+        assert timed_out and result is None
+        assert wd.timeouts == 1
+
+        def boom():
+            raise ValueError("inner")
+
+        with pytest.raises(ValueError, match="inner"):
+            wd.call(boom)
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.delenv(sup.DEADLINE_ENV, raising=False)
+        assert sup.SolveWatchdog.from_env() is None
+        monkeypatch.setenv(sup.DEADLINE_ENV, "2.5")
+        wd = sup.SolveWatchdog.from_env()
+        assert wd is not None and wd.deadline_s == 2.5
+        monkeypatch.setenv(sup.DEADLINE_ENV, "0")
+        assert sup.SolveWatchdog.from_env() is None
+        monkeypatch.setenv(sup.DEADLINE_ENV, "not-a-number")
+        assert sup.SolveWatchdog.from_env() is None
+
+    def test_hang_detected_and_escalated(self, monkeypatch):
+        """Acceptance drill: an injected hang is detected within the
+        configured deadline and surfaced in the health report; the hung
+        call is abandoned and its windows recover down the existing
+        ladder instead of stalling the process."""
+        monkeypatch.setenv(sup.DEADLINE_ENV, "0.3")
+        ref = MicrogridScenario(_small_case())
+        with faultinject.inject(hang={1}, hang_seconds=1.5):
+            s = MicrogridScenario(_small_case())
+            s.optimize_problem_loop(backend="cpu")
+        monkeypatch.delenv(sup.DEADLINE_ENV)
+        ref.optimize_problem_loop(backend="cpu")
+        assert s.quarantine is None
+        # the hung group (all windows co-batched) was abandoned as ONE
+        # call — one watchdog event — and every member recovered on the
+        # boosted-budget retry
+        assert s.health["watchdog_timeouts"] == 1
+        assert s.health["retried"] == len(s.windows)
+        assert s.health["clean"] == 0
+        for k in ref.objective_values:
+            assert s.objective_values[k]["Total Objective"] == \
+                pytest.approx(ref.objective_values[k]["Total Objective"],
+                              rel=1e-9)
+
+    def test_hang_in_health_report_and_metadata(self, monkeypatch):
+        from dervet_tpu.io.summary import run_health_report
+        monkeypatch.setenv(sup.DEADLINE_ENV, "0.3")
+        with faultinject.inject(hang={1}, hang_seconds=1.5) as plan:
+            s = MicrogridScenario(_small_case())
+            s.optimize_problem_loop(backend="cpu")
+        assert ("hang", "1") in plan.fired
+        assert s.solve_metadata["health"]["watchdog_timeouts"] > 0
+        report = run_health_report({0: s.health}, {})
+        assert report["watchdog_timeouts"] == 1
+        assert report["per_case"]["0"]["watchdog_timeouts"] == 1
+
+    def test_slow_solve_within_deadline_is_clean(self, monkeypatch):
+        """A bounded slowdown under the deadline must NOT trip the
+        watchdog — no false positives from the deadline machinery."""
+        monkeypatch.setenv(sup.DEADLINE_ENV, "30")
+        with faultinject.inject(slow={1}, slow_seconds=0.2) as plan:
+            s = MicrogridScenario(_small_case())
+            s.optimize_problem_loop(backend="cpu")
+        assert ("slow_solve", "1") in plan.fired
+        assert s.quarantine is None
+        assert s.health["watchdog_timeouts"] == 0
+        assert s.health["clean"] == len(s.windows)
+
+
+# ---------------------------------------------------------------------------
+# Graceful shutdown + resume
+# ---------------------------------------------------------------------------
+
+def _two_structure_sweep():
+    """Two cases whose windows differ in length (12 h vs 24 h): two
+    structure groups, hence two window-batch boundaries — the preempt
+    point lands BETWEEN the groups, leaving one case done and one
+    untouched."""
+    return [MicrogridScenario(_small_case(0, n=12)),
+            MicrogridScenario(_small_case(1, n=24))]
+
+
+class TestPreemptResume:
+    def test_sigterm_mid_sweep_then_resume(self, tmp_path):
+        """Acceptance drill: an injected SIGTERM mid-sweep exits cleanly
+        with a valid run_manifest.json, and a second run with the same
+        checkpoint_dir completes without re-dispatching ``done`` cases,
+        producing outputs identical to an uninterrupted run."""
+        ref = _two_structure_sweep()
+        run_dispatch(ref, backend="cpu")
+        ref_ts = {s.case.case_id: s.timeseries_results() for s in ref}
+
+        scns = _two_structure_sweep()
+        with faultinject.inject(preempt_after=1) as plan:
+            with sup.RunSupervisor() as rs:
+                with pytest.raises(PreemptedError) as ei:
+                    run_dispatch(scns, backend="cpu",
+                                 checkpoint_dir=tmp_path, supervisor=rs)
+        assert ("preempt", "1") in plan.fired
+        assert rs.stop_signal is not None
+        assert "stop requested" in str(ei.value)
+
+        manifest = json.loads(sup.manifest_path(tmp_path).read_text())
+        statuses = sorted(c["status"] for c in manifest["cases"].values())
+        assert statuses == ["done", "partial"]
+
+        # -- resume: the done case is reloaded, not re-dispatched --------
+        scns2 = _two_structure_sweep()
+        run_dispatch(scns2, backend="cpu", checkpoint_dir=tmp_path)
+        done_id = next(cid for cid, c in manifest["cases"].items()
+                       if c["status"] == "done")
+        for s in scns2:
+            assert s.quarantine is None
+            assert len(s.objective_values) == len(s.windows)
+            if str(s.case.case_id) == done_id:
+                assert s.solve_metadata.get("resumed_from_manifest") is True
+                assert s.solve_metadata["batched_solves"] == 0
+                assert sum(s.health[k] for k in
+                           ("clean", "retried", "cpu_fallback")) == 0
+            else:
+                assert "resumed_from_manifest" not in s.solve_metadata
+        # final outputs identical to the uninterrupted run
+        for s in scns2:
+            pd.testing.assert_frame_equal(
+                s.timeseries_results(), ref_ts[s.case.case_id])
+        for r, s in zip(ref, scns2):
+            for k in r.objective_values:
+                assert s.objective_values[k]["Total Objective"] == \
+                    pytest.approx(
+                        r.objective_values[k]["Total Objective"], rel=1e-12)
+        # the completed resume run marks every case done
+        manifest2 = json.loads(sup.manifest_path(tmp_path).read_text())
+        assert all(c["status"] == "done"
+                   for c in manifest2["cases"].values())
+
+    def test_fingerprint_mismatch_forces_full_dispatch(self, tmp_path):
+        """A manifest whose fingerprint does not match the case inputs
+        must NOT be trusted: the case re-dispatches from scratch."""
+        scns = _two_structure_sweep()
+        run_dispatch(scns, backend="cpu", checkpoint_dir=tmp_path)
+        manifest = json.loads(sup.manifest_path(tmp_path).read_text())
+        for c in manifest["cases"].values():
+            c["fingerprint"] = "stale-inputs"
+        sup.manifest_path(tmp_path).write_text(json.dumps(manifest))
+        scns2 = _two_structure_sweep()
+        run_dispatch(scns2, backend="cpu", checkpoint_dir=tmp_path)
+        for s in scns2:
+            assert "resumed_from_manifest" not in s.solve_metadata
+            # the per-window checkpoint self-verifies its own fingerprint
+            # (which still matches), so windows resume from it — but the
+            # manifest fast path was refused
+            assert s.quarantine is None
+
+    def test_stop_flag_without_signals(self, tmp_path):
+        """The supervisor works as a plain stop-flag where handlers
+        cannot be installed: a pre-requested stop halts at the FIRST
+        batch boundary and still flushes the manifest."""
+        rs = sup.RunSupervisor(install_signals=False)
+        rs.request_stop()
+        scns = _two_structure_sweep()
+        with pytest.raises(PreemptedError):
+            run_dispatch(scns, backend="cpu", checkpoint_dir=tmp_path,
+                         supervisor=rs)
+        manifest = json.loads(sup.manifest_path(tmp_path).read_text())
+        assert set(manifest["cases"]) == {"0", "1"}
+
+    def test_preempt_without_checkpoint_dir_still_raises(self):
+        rs = sup.RunSupervisor(install_signals=False)
+        rs.request_stop()
+        with pytest.raises(PreemptedError):
+            run_dispatch(_two_structure_sweep(), backend="cpu",
+                         supervisor=rs)
+
+    def test_second_signal_escalates(self):
+        """The first signal only requests a stop; handler bookkeeping for
+        the second-signal escape hatch restores the default disposition
+        (asserted without actually re-delivering, which would kill the
+        test process)."""
+        import signal as _signal
+        with sup.RunSupervisor() as rs:
+            assert not rs.stop_requested()
+            rs._on_signal(_signal.SIGTERM, None)
+            assert rs.stop_requested()
+            assert rs.stop_signal == _signal.SIGTERM
+        # context exit restored the original handlers
+        assert _signal.getsignal(_signal.SIGTERM) \
+            is _signal.SIG_DFL or callable(
+                _signal.getsignal(_signal.SIGTERM))
+
+
+class TestCLIExitCode:
+    def test_preempted_maps_to_exit_75(self, monkeypatch, tmp_path):
+        import dervet_tpu.api as api
+        import dervet_tpu.__main__ as cli
+
+        class FakeDERVET:
+            def __init__(self, path, verbose=False, base_path=None):
+                pass
+
+            def solve(self, backend="auto", checkpoint_dir=None):
+                raise PreemptedError("stop requested (signal 15)")
+
+        monkeypatch.setattr(api, "DERVET", FakeDERVET)
+        with pytest.raises(SystemExit) as ei:
+            cli.main([str(tmp_path / "params.csv")])
+        assert ei.value.code == sup.EXIT_PREEMPTED == 75
+
+
+class TestFaultEnvKnobs:
+    def test_new_env_knobs_parse(self, monkeypatch):
+        monkeypatch.setenv("DERVET_TPU_FAULT_HANG", "1")
+        monkeypatch.setenv("DERVET_TPU_FAULT_HANG_S", "7.5")
+        monkeypatch.setenv("DERVET_TPU_FAULT_SLOW", "2")
+        monkeypatch.setenv("DERVET_TPU_FAULT_SLOW_S", "0.5")
+        monkeypatch.setenv("DERVET_TPU_FAULT_PREEMPT_AFTER", "3")
+        plan = faultinject.get_plan()
+        assert plan is not None
+        secs, kind = plan.sleep_seconds([1], faultinject.RUNG_SOLVE)
+        assert (secs, kind) == (7.5, faultinject.EVENT_HANG)
+        secs, kind = plan.sleep_seconds([2], faultinject.RUNG_SOLVE)
+        assert (secs, kind) == (0.5, faultinject.EVENT_SLOW)
+        assert not plan.preempt_due(2)
+        assert plan.preempt_due(3)
+        assert not plan.preempt_due(4)     # one-shot
+
+    def test_hang_wins_over_slow_on_same_label(self):
+        plan = faultinject.FaultPlan(hang={1}, hang_seconds=2.0,
+                                     slow={1}, slow_seconds=0.1)
+        secs, kind = plan.sleep_seconds([1], faultinject.RUNG_SOLVE)
+        assert (secs, kind) == (2.0, faultinject.EVENT_HANG)
+
+    def test_sleep_respects_rungs(self):
+        plan = faultinject.FaultPlan(hang={1}, rungs={"retry"})
+        assert plan.sleep_seconds([1], faultinject.RUNG_SOLVE) == (0.0, "")
+        secs, _ = plan.sleep_seconds([1], faultinject.RUNG_RETRY)
+        assert secs > 0
