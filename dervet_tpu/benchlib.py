@@ -92,6 +92,110 @@ def synthetic_case(year: int = 2017, n="month", dt: float = 1.0,
     )
 
 
+def synthetic_sensitivity_cases(n_cases: int, year: int = 2017,
+                                n="month", dt: float = 1.0,
+                                months: int = 0, seed: int = 0
+                                ) -> List[CaseParams]:
+    """A synthetic sensitivity fan-out: ``n_cases`` copies of the
+    Battery+PV+DA case sweeping the battery energy rating (the same
+    bounds-only sweep shape as the reference's Sensitivity-Parameters
+    fan-out, dervet/DERVET.py:75-83) — so the batched dispatch pipeline
+    can be exercised without the reference dataset.  ``months`` > 0 trims
+    the horizon to the first N calendar months (``allow_partial_year``)
+    to keep CI-sized runs fast."""
+    import dataclasses
+    out = []
+    for i in range(n_cases):
+        # synthetic_case builds fresh key dicts + time series per call, so
+        # each case owns its data (MicrogridScenario mutates datasets)
+        c = synthetic_case(year=year, n=n, dt=dt, seed=seed)
+        c = dataclasses.replace(c, case_id=i)
+        for tag, _, keys in c.ders:
+            if tag == "Battery":
+                keys["ene_max_rated"] = 8000.0 * (0.8 + 0.8 * i
+                                                  / max(n_cases - 1, 1))
+        if months:
+            ts = c.datasets.time_series
+            c.datasets.time_series = ts.loc[ts.index.month <= months]
+            c.scenario["allow_partial_year"] = True
+        out.append(c)
+    return out
+
+
+def widen_sensitivity_csv(src, out_path, n_cases: int,
+                          lo: float = 0.8, hi: float = 1.6):
+    """Rewrite a reference model-params CSV so Battery ``ene_max_rated``
+    fans out to ``n_cases`` Sensitivity-Parameters values spanning
+    [lo, hi] x the stock rating — the shared construction behind
+    bench.py's sensitivity leg and the large sharded-fanout test (one
+    edit site when the reference input's column naming changes)."""
+    df = pd.read_csv(src)
+    sel = (df.Tag == "Battery") & (df.Key == "ene_max_rated")
+    # older reference inputs name the value column 'Value'
+    val_col = "Optimization Value" if "Optimization Value" in df.columns \
+        else "Value"
+    base = float(df.loc[sel, val_col].iloc[0])
+    vals = np.linspace(lo, hi, n_cases) * base
+    # the column is all-NaN float64 in the stock input; make it object
+    # before writing a list string into it
+    df["Sensitivity Parameters"] = df["Sensitivity Parameters"].astype(object)
+    df.loc[sel, "Sensitivity Parameters"] = \
+        "[" + ", ".join(f"{v:.1f}" for v in vals) + "]"
+    df.loc[sel, "Sensitivity Analysis"] = "yes"
+    df.to_csv(out_path, index=False)
+    return out_path
+
+
+# solve-ledger schema: the observable contract bench.py publishes under
+# legs.*.solve_ledger and CI's cpu-backend smoke asserts (no chip needed).
+# Every group entry must carry the batch shape + wall clock; jax entries
+# additionally carry the device-traffic split.
+LEDGER_TOTALS_KEYS = (
+    "solve_s", "stack_s", "h2d_s", "sync_wait_s", "result_fetch_s",
+    "other_s", "h2d_bytes", "result_bytes", "dispatches", "chunks",
+    "readbacks", "compile_events", "windows")
+LEDGER_GROUP_KEYS = ("backend", "batch", "solve_s")
+LEDGER_JAX_GROUP_KEYS = (
+    "m", "n", "sharded", "staged", "stack_s", "iters_p50", "iters_p99",
+    "iters_max", "dispatches", "chunks", "compile_events", "h2d_bytes",
+    "h2d_s", "readbacks", "sync_wait_s", "result_fetch_s",
+    "bucket_occupancy", "other_s")
+
+
+def validate_solve_ledger(ledger: Dict) -> Dict:
+    """Schema-check a ``solve_ledger`` dict (raises ``ValueError`` with
+    the missing/invalid field named).  Returns the ledger unchanged so
+    callers can chain it.  Checked here rather than in a test so the
+    BENCH artifact itself fails loudly on a malformed ledger."""
+    if not isinstance(ledger, dict):
+        raise ValueError(f"solve_ledger must be a dict, got {type(ledger)}")
+    for k in ("groups", "totals", "dispatch_solve_s",
+              "accounted_fraction", "pipeline", "max_inflight"):
+        if k not in ledger:
+            raise ValueError(f"solve_ledger missing {k!r}")
+    if not isinstance(ledger["groups"], list) or not ledger["groups"]:
+        raise ValueError("solve_ledger.groups must be a non-empty list")
+    totals = ledger["totals"]
+    for k in LEDGER_TOTALS_KEYS:
+        if k not in totals:
+            raise ValueError(f"solve_ledger.totals missing {k!r}")
+        if not isinstance(totals[k], (int, float)):
+            raise ValueError(f"solve_ledger.totals[{k!r}] not numeric")
+    for i, g in enumerate(ledger["groups"]):
+        for k in LEDGER_GROUP_KEYS:
+            if k not in g:
+                raise ValueError(f"solve_ledger.groups[{i}] missing {k!r}")
+        if g.get("backend") != "cpu" and g.get("rung") != "cpu_fallback":
+            for k in LEDGER_JAX_GROUP_KEYS:
+                if k not in g:
+                    raise ValueError(
+                        f"solve_ledger.groups[{i}] (jax) missing {k!r}")
+    af = ledger["accounted_fraction"]
+    if af is not None and not 0.0 <= af <= 2.0:
+        raise ValueError(f"accounted_fraction out of range: {af}")
+    return ledger
+
+
 def build_window_lps(case: CaseParams, pad_to_max: bool = False
                      ) -> Tuple[MicrogridScenario, Dict[int, List[LP]]]:
     """Assemble every optimization window's LP, grouped by window length.

@@ -54,7 +54,7 @@ def scenario_mesh(n_devices: Optional[int] = None) -> Mesh:
 
 
 def solve_batch_sharded(solver: CompiledLPSolver, mesh: Mesh,
-                        c=None, q=None, l=None, u=None):
+                        c=None, q=None, l=None, u=None, stats=None):
     """Solve a batch of LP instances sharded over ``mesh``.
 
     Any of ``c/q/l/u`` may be 1-D (shared, replicated) or 2-D batched on the
@@ -78,7 +78,8 @@ def solve_batch_sharded(solver: CompiledLPSolver, mesh: Mesh,
     # (ADVICE r4 / review r5)
     with solver._solve_lock:
         try:
-            return _solve_batch_sharded_inner(solver, mesh, c, q, l, u)
+            return _solve_batch_sharded_inner(solver, mesh, c, q, l, u,
+                                              stats)
         except Exception as e:
             from ..ops import pallas_chunk
             kernel_in_play = (solver.opts.pallas_chunk
@@ -92,12 +93,25 @@ def solve_batch_sharded(solver: CompiledLPSolver, mesh: Mesh,
             solver.opts = dataclasses.replace(solver.opts,
                                               pallas_chunk=False)
             solver._make_jits()
-            return _solve_batch_sharded_inner(solver, mesh, c, q, l, u)
+            # fresh jits = fresh XLA programs: reset compile-event tracking
+            solver._exec_shapes.clear()
+            return _solve_batch_sharded_inner(solver, mesh, c, q, l, u,
+                                              stats)
 
 
 def _solve_batch_sharded_inner(solver: CompiledLPSolver, mesh: Mesh,
-                               c=None, q=None, l=None, u=None):
-    c, q, l, u = solver._data(c, q, l, u)
+                               c=None, q=None, l=None, u=None, stats=None):
+    import time
+
+    from ..ops.pdhg import SolveStats
+    # same per-solve traffic accounting as the single-device driver, so
+    # the dispatch solve ledger stays populated on a multi-chip mesh.
+    # Callers that must not race pass their OWN stats; last_stats is
+    # assigned under _solve_lock (we are inside it here).
+    if stats is None:
+        stats = SolveStats()
+    solver.last_stats = stats
+    c, q, l, u = solver._data(c, q, l, u, stats)
     sizes = {arr.shape[0] for arr in (c, q, l, u) if arr.ndim == 2}
     if not sizes:
         raise ValueError("solve_batch_sharded needs at least one batched input")
@@ -159,17 +173,27 @@ def _solve_batch_sharded_inner(solver: CompiledLPSolver, mesh: Mesh,
                                            max_prim_res=P()))))
 
     opts = solver.opts
+    solver._note_exec("sh_init", c.shape, stats)
     state = sh_init(c, q, l, u)
+    stats.dispatches += 1
     total = 0
     while True:
         limit = jnp.asarray(min(total + opts.chunk_iters, opts.max_iters),
                             jnp.int32)
+        solver._note_exec("sh_chunk", c.shape, stats)
         state = sh_chunk(c, q, l, u, state, limit)
+        t0 = time.perf_counter()
         total = int(np.asarray(state.total).max())
         active = ~(np.asarray(state.converged) | np.asarray(state.infeasible))
+        stats.dispatches += 1
+        stats.chunks += 1
+        stats.readbacks += 1
+        stats.sync_wait_s += time.perf_counter() - t0
         if not active.any() or total >= opts.max_iters:
             break
-    res, stats = sh_fin(c, q, l, u, state, valid)
+    solver._note_exec("sh_fin", c.shape, stats)
+    res, sh_stats = sh_fin(c, q, l, u, state, valid)
+    stats.dispatches += 1
     if B_pad != B:
         res = PDHGResult(*(a[:B] for a in res))
-    return res, stats
+    return res, sh_stats

@@ -37,11 +37,23 @@ class Result:
         # run-health report (resilience layer), attached by api.solve:
         # per-window ladder counts + quarantined-case diagnoses
         self.run_health: Optional[Dict] = None
+        # per-group solve ledger (perf observability), attached by
+        # api.solve from the dispatch driver's solve_metadata
+        self.solve_ledger: Optional[Dict] = None
 
-    def add_instance(self, key: int, scenario) -> "CaseResult":
+    def build_instance(self, scenario) -> "CaseResult":
+        """Build (but do not register) one case's result frames — the
+        pandas-heavy half of ``add_instance``, split out so the api layer
+        can fan it out over a worker pool overlapped with the remaining
+        dispatch solves (cases are independent; registration stays on the
+        caller's thread, in case order)."""
         inst = CaseResult(scenario, self.csv_label)
         inst.collect_results()
         inst.calculate_cba()
+        return inst
+
+    def add_instance(self, key: int, scenario) -> "CaseResult":
+        inst = self.build_instance(scenario)
         self.instances[key] = inst
         return inst
 

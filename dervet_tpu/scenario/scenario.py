@@ -380,6 +380,7 @@ class MicrogridScenario:
         self._ckpt_backlog = 0
         self.quarantine = None
         self.health = _new_health()
+        self._scattered = False
         self._solution: Dict[str, np.ndarray] = {}
         self._solved: set = set()
         deferral = self.streams.get("Deferral")
@@ -482,6 +483,7 @@ class MicrogridScenario:
         self._ckpt_backlog = 0
         self.quarantine = None
         self.health = _new_health()
+        self._scattered = False
         self._solution = solution
         self._solved = solved
         self._requirements = []
@@ -599,7 +601,10 @@ class MicrogridScenario:
                     not getattr(self, "_resumed_done", False):
                 self._save_checkpoint(self._checkpoint_dir, self._solution,
                                       self._solved)
-            if self.quarantine is None:
+            if self.quarantine is None and \
+                    not getattr(self, "_scattered", False):
+                # the on_case_solved fast path may have scattered already
+                # (api overlaps per-case post with the remaining solves)
                 self._scatter_to_ders(self._solution)
             # windows never dispatched because the case quarantined first
             # land in 'skipped', so a quarantined case's buckets still sum
@@ -943,8 +948,71 @@ class SolverCache:
         return solver
 
 
+def _stack_group_data(lps: List[LP], sdt, multi_dev: bool):
+    """Stack per-instance ``c/q/l/u`` for a structure group, cast to the
+    solver dtype in the same pass (the default is f32, so stacking at f64
+    doubles host memory traffic only to cast on transfer).  A vector
+    IDENTICAL across the group (e.g. costs in a bounds-only sensitivity
+    sweep) collapses to 1-D — the solver broadcasts it ON DEVICE, so a
+    (512, n) block never crosses the tunnel.  Single-device only: the
+    sharded path pads + shard_maps its batched inputs, and broadcast
+    views there measured a pathological slowdown on the virtual-device
+    test platform."""
+    def stack_cast(attr):
+        rows = [getattr(lp, attr) for lp in lps]
+        first = rows[0]
+        if not multi_dev and all(r is first or np.array_equal(r, first)
+                                 for r in rows[1:]):
+            return np.asarray(first, sdt)
+        out = np.empty((len(lps), first.shape[0]), sdt)
+        for i, r in enumerate(rows):
+            out[i] = r
+        return out
+
+    return tuple(stack_cast(a) for a in ("c", "q", "l", "u"))
+
+
+class StagedGroupData:
+    """A subgroup's stacked instance data with its device upload already
+    ENQUEUED (``jax.device_put`` is async): staging group i+1 on the
+    dispatch thread while group i's solve is in flight double-buffers the
+    host->device uploads under the running solve — the transfer is done
+    (or well underway) by the time the solver first touches the data."""
+    __slots__ = ("arrays", "stack_s", "h2d_s", "h2d_bytes")
+
+    def __init__(self, arrays, stack_s, h2d_s, h2d_bytes):
+        self.arrays = arrays
+        self.stack_s = stack_s
+        self.h2d_s = h2d_s
+        self.h2d_bytes = h2d_bytes
+
+
+def stage_group_data(items, solver_opts,
+                     force: bool = False) -> Optional[StagedGroupData]:
+    """Stack + start uploading a verified subgroup's LP data (see
+    ``StagedGroupData``).  Single-accelerator only: the sharded path
+    reshards its inputs itself, and pre-staging to the default device
+    would just add a device->device hop.  ``force`` overrides the
+    device-count guard (unit tests run on a virtual multi-device mesh)."""
+    import jax
+    from ..ops.pdhg import PDHGOptions
+    if (len(jax.devices()) > 1 or len(items) < 2) and not force:
+        return None
+    lps = [lp for (_, _, lp) in items]
+    sdt = np.dtype((solver_opts or PDHGOptions()).dtype)
+    t0 = time.perf_counter()
+    arrs = _stack_group_data(lps, sdt, multi_dev=False)
+    t1 = time.perf_counter()
+    dev = jax.device_put(arrs)
+    t2 = time.perf_counter()
+    return StagedGroupData(tuple(dev), t1 - t0, t2 - t1,
+                           sum(a.nbytes for a in arrs))
+
+
 def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
-                key=None, cache: Optional[SolverCache] = None, labels=None):
+                key=None, cache: Optional[SolverCache] = None, labels=None,
+                staged: Optional[StagedGroupData] = None, ledger=None,
+                ledger_meta=None):
     """Solve a group of structure-identical LPs.  Backend 'cpu' = exact
     HiGHS per instance; 'jax' = ONE batched PDHG device call, sharded over
     the scenario-axis mesh when more than one accelerator is visible
@@ -953,6 +1021,12 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
     reused across calls that share a structure key.  ``labels`` (parallel
     to ``lps``) names each window in diagnostics.
 
+    ``staged`` carries the group's instance data already stacked and
+    uploaded (the dispatch pipeline stages group i+1 under group i's
+    solve); ``ledger``/``ledger_meta`` collect the per-group solve-ledger
+    entry (VERDICT r5 #1) — batch shape, wall-clock split, device-traffic
+    stats, iteration percentiles.
+
     Returns ``(xs, objs, ok, diags, statuses)`` — statuses are the
     ``ops.pdhg.STATUS_*`` codes (CPU results are mapped onto them), so the
     escalation ladder upstream can tell a certified infeasibility from an
@@ -960,7 +1034,9 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
     from ..ops.pdhg import (STATUS_CONVERGED, STATUS_INACCURATE,
                             STATUS_ITER_LIMIT, STATUS_PRIMAL_INFEASIBLE,
                             CompiledLPSolver, PDHGOptions,
-                            diagnose_infeasibility, status_message)
+                            diagnose_infeasibility, fetch_result_host,
+                            status_message)
+    t_wall = time.perf_counter()
     if backend == "cpu":
         xs, objs, ok, diags, statuses = [], [], [], [], []
         for lp in lps:
@@ -975,49 +1051,39 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
                 STATUS_CONVERGED if res.status == 0 else
                 STATUS_PRIMAL_INFEASIBLE if res.status == 2 else
                 STATUS_ITER_LIMIT)
+        if ledger is not None:
+            ledger.append({**(ledger_meta or {}),
+                           "backend": "cpu", "m": lp0.m, "n": lp0.n,
+                           "batch": len(lps),
+                           "solve_s": round(time.perf_counter() - t_wall,
+                                            4)})
         return xs, objs, ok, diags, statuses
     if cache is not None and key is not None:
         solver = cache.get(key, lp0, solver_opts)
     else:
         solver = CompiledLPSolver(lp0, solver_opts or PDHGOptions())
+    import jax
+    from ..ops.pdhg import SolveStats
+    # caller-owned stats: the pipeline can route two same-structure
+    # subgroups to ONE cached solver from different workers, and a shared
+    # solver.last_stats read-back would cross-wire their ledger entries
+    stats = SolveStats()
+    multi_dev = len(jax.devices()) > 1
+    t_stack = 0.0
     if len(lps) == 1:
         # pass the instance data explicitly: a cached solver's built-in
         # defaults belong to the FIRST window of its structure group
         lp = lps[0]
-        res = solver.solve(c=lp.c, q=lp.q, l=lp.l, u=lp.u)
-        statuses = [int(res.status)]
-        xs = [np.asarray(res.x)]
-        objs = [float(res.obj)]
-        ok = [bool(res.converged)]
+        res = solver.solve(c=lp.c, q=lp.q, l=lp.l, u=lp.u, stats=stats)
     else:
-        import jax
-
-        sdt = np.dtype(solver.opts.dtype)   # jnp scalar types are np-compatible
-
-        multi_dev = len(jax.devices()) > 1
-
-        def stack_cast(attr):
-            # single-pass cast to the solver dtype while stacking: the
-            # default is f32, so stacking at f64 doubles host memory
-            # traffic only to cast on transfer.  A vector IDENTICAL
-            # across the group (e.g. costs in a bounds-only sensitivity
-            # sweep) collapses to 1-D — the solver broadcasts it ON
-            # DEVICE, so a (512, n) block never crosses the tunnel.
-            # Single-device only: the sharded path pads + shard_maps its
-            # batched inputs, and broadcast views there measured a
-            # pathological slowdown on the virtual-device test platform.
-            rows = [getattr(lp, attr) for lp in lps]
-            first = rows[0]
-            if not multi_dev and all(r is first or np.array_equal(r, first)
-                                     for r in rows[1:]):
-                return np.asarray(first, sdt)
-            out = np.empty((len(lps), first.shape[0]), sdt)
-            for i, r in enumerate(rows):
-                out[i] = r
-            return out
-
-        C, Q, L, U = (stack_cast(a) for a in ("c", "q", "l", "u"))
-        if all(a.ndim == 1 for a in (C, Q, L, U)):
+        if staged is not None:
+            C, Q, L, U = staged.arrays
+        else:
+            sdt = np.dtype(solver.opts.dtype)   # jnp types are np-compatible
+            t0 = time.perf_counter()
+            C, Q, L, U = _stack_group_data(lps, sdt, multi_dev)
+            t_stack = time.perf_counter() - t0
+        if all(np.ndim(a) == 1 for a in (C, Q, L, U)):
             # fully-degenerate group (nothing varies): keep one axis
             # batched so solve() returns per-instance results — broadcast
             # ON DEVICE so the transfer stays the 1-D vector (a host
@@ -1025,22 +1091,68 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
             # exists to avoid)
             import jax.numpy as jnp
             Q = jnp.broadcast_to(jax.device_put(Q), (len(lps), Q.shape[0]))
-        if len(jax.devices()) > 1:
+        if multi_dev:
             from ..parallel import scenario_mesh, solve_batch_sharded
             res, _ = solve_batch_sharded(solver, scenario_mesh(),
-                                         c=C, q=Q, l=L, u=U)
+                                         c=C, q=Q, l=L, u=U, stats=stats)
         else:
-            res = solver.solve(c=C, q=Q, l=L, u=U)
-        statuses = [int(s) for s in np.asarray(res.status)]
-        xs = list(np.asarray(res.x))
-        objs = [float(o) for o in np.asarray(res.obj)]
-        ok = list(np.asarray(res.converged))
+            res = solver.solve(c=C, q=Q, l=L, u=U, stats=stats)
+    # ONE fused device->host fetch of every consumed result field (x,
+    # obj, converged, iters, residuals, status) instead of one fetch per
+    # field — seven ~100 ms round trips per group become one on remote
+    # backends.  The dual block y stays on device unless a certificate
+    # needs it (below).
+    x_h, obj_h, conv_h, iters_h, pr_h, gap_h, st_h = \
+        fetch_result_host(res, stats)
+    if np.ndim(x_h) == 1:
+        statuses = [int(st_h)]
+        xs = [np.asarray(x_h)]
+        objs = [float(obj_h)]
+        ok = [bool(conv_h)]
+    else:
+        statuses = [int(s) for s in np.asarray(st_h)]
+        xs = list(np.asarray(x_h))
+        objs = [float(o) for o in np.asarray(obj_h)]
+        ok = list(np.asarray(conv_h))
+    if ledger is not None:
+        it = np.atleast_1d(np.asarray(iters_h))
+        entry = {**(ledger_meta or {}),
+                 "backend": backend, "m": lp0.m, "n": lp0.n,
+                 "batch": len(lps),
+                 # single-window groups ride solver.solve even on a
+                 # multi-device mesh — only real batches shard
+                 "sharded": bool(multi_dev and len(lps) > 1),
+                 "staged": staged is not None,
+                 "solve_s": round(time.perf_counter() - t_wall, 4),
+                 "stack_s": round(t_stack, 4),
+                 "iters_p50": int(np.percentile(it, 50)),
+                 "iters_p99": int(np.percentile(it, 99)),
+                 "iters_max": int(it.max()),
+                 "_iters": it}
+        if staged is not None:
+            # staged staging ran on the dispatch thread, OVERLAPPED with
+            # an earlier group's solve — out-of-wall, reported separately
+            entry["staged_stack_s"] = round(staged.stack_s, 4)
+            entry["staged_h2d_s"] = round(staged.h2d_s, 4)
+            entry["h2d_bytes"] = staged.h2d_bytes
+        d = stats.as_dict()
+        entry["h2d_bytes"] = entry.get("h2d_bytes", 0) + d["h2d_bytes"]
+        for k in ("dispatches", "chunks", "compile_events",
+                  "h2d_s", "readbacks", "sync_wait_s",
+                  "result_fetch_s", "result_bytes", "cpu_rescued",
+                  "compact_events", "bucket_occupancy"):
+            entry[k] = d[k]
+        # the staged device_put bypasses _data's counter — count its
+        # arrays here so bytes and transfers stay mutually consistent
+        entry["h2d_transfers"] = d["h2d_transfers"] + (
+            len(staged.arrays) if staged is not None else 0)
+        ledger.append(entry)
     # accept near-converged iteration-limit exits with a warning — the
     # reference accepts CVXPY 'optimal_inaccurate' the same way.  The
     # warning names the window and its actual KKT residuals: with
     # hundreds of batched windows an anonymous message is unactionable.
-    prim_res = np.atleast_1d(np.asarray(res.prim_res))
-    gaps = np.atleast_1d(np.asarray(res.gap))
+    prim_res = np.atleast_1d(np.asarray(pr_h))
+    gaps = np.atleast_1d(np.asarray(gap_h))
     factor = (solver_opts or PDHGOptions()).inaccurate_factor
     for i, s in enumerate(statuses):
         if s == STATUS_INACCURATE:
@@ -1193,7 +1305,8 @@ def _guarded_solve(watchdog, rung_desc: str, lps, labels, call):
 
 
 def resolve_group(items, backend: str, solver_opts, key=None,
-                  cache: Optional[SolverCache] = None, watchdog=None):
+                  cache: Optional[SolverCache] = None, watchdog=None,
+                  staged: Optional[StagedGroupData] = None, ledger=None):
     """Solve a window group with the per-window escalation ladder.
 
     ``items`` is a list of ``(scenario, ctx, lp)`` (structure-identical
@@ -1217,18 +1330,30 @@ def resolve_group(items, backend: str, solver_opts, key=None,
         STATUS_ITER_LIMIT
     lps = [lp for (_, _, lp) in items]
     labels = [ctx.label for (_, ctx, _) in items]
+    meta = {"rung": "initial", "T": getattr(items[0][1], "T", None),
+            "windows": len(items),
+            "cases": len({id(s) for (s, _, _) in items})}
+    # the watchdog may ABANDON a wedged solve on a daemon thread; handing
+    # solve_group the shared ledger would let that zombie append a
+    # full-wall entry after the deadline cut dispatch_solve_s short (or
+    # after the summary already ran) — so solves write to a PRIVATE list
+    # merged only on a non-timed-out return
+    local_ledger = [] if ledger is not None else None
 
     def _call():
         # hang/slow faults sleep INSIDE the guarded closure, exactly
         # where a wedged device call would be observed
         faultinject.maybe_sleep(labels, faultinject.RUNG_SOLVE)
         return solve_group(lps[0], lps, backend, solver_opts, key=key,
-                           cache=cache, labels=labels)
+                           cache=cache, labels=labels, staged=staged,
+                           ledger=local_ledger, ledger_meta=meta)
 
     (xs, objs, ok, diags, statuses), timed_out = _guarded_solve(
         watchdog, "initial", lps, labels, _call)
     if timed_out:
         _count_watchdog_timeout(items, range(len(items)))
+    elif ledger is not None:
+        ledger.extend(local_ledger)
     plan = faultinject.get_plan()
     if plan is not None:
         for i, (s, ctx, lp) in enumerate(items):
@@ -1252,12 +1377,12 @@ def resolve_group(items, backend: str, solver_opts, key=None,
                          else "clean"] += 1
     if fail_idx:
         _escalate(items, fail_idx, xs, objs, ok, diags, statuses,
-                  backend, solver_opts, key, cache, watchdog)
+                  backend, solver_opts, key, cache, watchdog, ledger=ledger)
     return xs, objs, ok, diags
 
 
 def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
-              solver_opts, key, cache, watchdog=None) -> None:
+              solver_opts, key, cache, watchdog=None, ledger=None) -> None:
     """Escalation ladder for a group's failed members (mutates the result
     lists in place).
 
@@ -1312,15 +1437,24 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
             f"window(s) {sub_labels} with {LADDER_ITER_BOOST}x iteration "
             "budget")
 
+        # private list for the same zombie-append hazard as the initial
+        # rung (see resolve_group)
+        retry_ledger = [] if ledger is not None else None
+
         def _retry_call():
             faultinject.maybe_sleep(sub_labels, faultinject.RUNG_RETRY)
             return solve_group(sub_lps[0], sub_lps, backend, boosted,
-                               key=rkey, cache=cache, labels=sub_labels)
+                               key=rkey, cache=cache, labels=sub_labels,
+                               ledger=retry_ledger,
+                               ledger_meta={"rung": "retry",
+                                            "windows": len(sub_lps)})
 
         (rxs, robjs, rok, rdiags, rstatuses), r_timed_out = _guarded_solve(
             watchdog, "retry", sub_lps, sub_labels, _retry_call)
         if r_timed_out:
             _count_watchdog_timeout(items, retry_idx)
+        elif ledger is not None:
+            ledger.extend(retry_ledger)
         for j, i in enumerate(retry_idx):
             label = items[i][1].label
             if rok[j] and plan is not None and plan.force_nonconverge(
@@ -1342,7 +1476,9 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
                 # carry the retry's (possibly changed) verdict into rung 2
                 diags[i], statuses[i] = rdiags[j], rstatuses[j]
     # ---- rung 2: exact CPU fallback, one member at a time ----
-    for i in [i for i in fail_idx if not ok[i]]:
+    t_rung2 = time.perf_counter()
+    rung2_idx = [i for i in fail_idx if not ok[i]]
+    for i in rung2_idx:
         s, ctx, lp = items[i]
         if plan is not None and plan.cpu_should_fail(ctx.label):
             diags[i] = (f"{diags[i]}; fault injection: CPU fallback "
@@ -1376,6 +1512,10 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
             # keep the richer dual-ray diagnosis when PDHG certified
             # infeasibility; otherwise HiGHS's verdict is the better one
             diags[i] = res.message or diags[i]
+    if ledger is not None and rung2_idx:
+        ledger.append({"rung": "cpu_fallback", "backend": "cpu",
+                       "batch": len(rung2_idx),
+                       "solve_s": round(time.perf_counter() - t_rung2, 4)})
     # ladder wall time is attributed proportionally to each involved
     # case's failed-member count: the per-case values then SUM to the real
     # elapsed time, so the run report's aggregate is not inflated by the
@@ -1390,8 +1530,118 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
             s.health["retry_seconds"] += elapsed * n / len(fail_idx)
 
 
+PIPELINE_ENV = "DERVET_TPU_PIPELINE"
+
+
+def _pipeline_enabled() -> bool:
+    """Overlapped-dispatch kill switch: ``DERVET_TPU_PIPELINE=0`` forces
+    the strict serial reference path (assemble -> solve -> scatter, one
+    group at a time on one thread).  The pipeline and the serial path
+    produce byte-identical results by construction — identical grouping,
+    identical batches, only execution overlap differs — and the serial
+    mode exists so a test can ASSERT that instead of trusting it."""
+    import os
+    return os.environ.get(PIPELINE_ENV, "1").strip().lower() \
+        not in ("0", "false", "off")
+
+
+def _pipeline_depth(multi_dev: bool) -> int:
+    """In-flight group bound for the overlapped dispatch.
+
+    0 = serial reference mode (``DERVET_TPU_PIPELINE=0``); an explicit
+    integer > 1 in the env var pins the depth.  Default: 1 on a
+    multi-device mesh (two sharded programs launched from different
+    threads interleave their collectives and abort the process — see the
+    pipeline comment below), else at least 2 EVEN ON A 1-CPU HOST: the
+    r5 measurement that three concurrent solve drivers fought over the
+    GIL was taken when each worker did its own (B, n) stacking and seven
+    per-field readbacks; both are gone (staging on the dispatch thread,
+    one fused fetch), so a worker now spends its life blocked in
+    GIL-releasing device waits — while worker A waits on group A's
+    chunk status, worker B ENQUEUES group B's next chunk and the
+    accelerator never idles through the host round trip.  That is the
+    'enqueue all groups, then drain' shape with bounded memory."""
+    import os
+    raw = os.environ.get(PIPELINE_ENV, "1").strip().lower()
+    if raw in ("0", "false", "off"):
+        return 0
+    if multi_dev:
+        return 1
+    try:
+        explicit = int(raw)
+    except ValueError:
+        explicit = 1
+    if explicit > 1:
+        return explicit
+    return max(2, min(3, os.cpu_count() or 1))
+
+
+def summarize_solve_ledger(entries, dispatch_solve_s: float,
+                           pipeline: bool, max_inflight: int) -> Dict:
+    """Aggregate per-group solve-ledger entries into the published
+    ``solve_ledger`` observable (VERDICT r5 #1: the 60x per-LP gap must
+    decompose into named, reproducible numbers).
+
+    Per jax entry, the IN-WALL split is ``stack_s + h2d_s + sync_wait_s
+    + result_fetch_s + other_s == solve_s`` (``other_s`` is host Python:
+    status mapping, enqueue overhead, GIL waits); staged uploads ran
+    overlapped on the dispatch thread and are reported out-of-wall
+    (``staged_stack_s``/``staged_h2d_s``).  ``totals.solve_s`` sums the
+    entry walls — cumulative across pipeline threads, the same
+    convention as ``dispatch_solve_s`` — so ``accounted_fraction``
+    states how much of the measured solve phase the ledger explains."""
+    groups = []
+    totals = {k: 0.0 for k in ("solve_s", "stack_s", "h2d_s",
+                               "sync_wait_s", "result_fetch_s", "other_s",
+                               "staged_stack_s", "staged_h2d_s")}
+    counts = {k: 0 for k in ("h2d_bytes", "result_bytes", "dispatches",
+                             "chunks", "readbacks", "compile_events",
+                             "h2d_transfers", "cpu_rescued",
+                             "compact_events", "windows")}
+    iters_all = []
+    for e in entries:
+        e = dict(e)
+        it = e.pop("_iters", None)
+        if it is not None:
+            iters_all.append(np.asarray(it).ravel())
+        if e.get("backend") != "cpu":
+            known = sum(e.get(k, 0.0) for k in
+                        ("stack_s", "h2d_s", "sync_wait_s",
+                         "result_fetch_s"))
+            e["other_s"] = round(max(0.0, e.get("solve_s", 0.0) - known), 4)
+        for k in totals:
+            totals[k] += float(e.get(k, 0.0))
+        for k in counts:
+            if k == "windows":
+                # DISTINCT windows: retry/cpu_fallback rungs re-solve
+                # members the initial rung already counted — including
+                # them would flatter any per-LP rate derived from totals
+                if e.get("rung") in (None, "initial"):
+                    counts[k] += int(e.get("batch", 0))
+            else:
+                counts[k] += int(e.get(k, 0))
+        groups.append(e)
+    out = {
+        "groups": groups,
+        "totals": {**{k: round(v, 3) for k, v in totals.items()}, **counts},
+        "dispatch_solve_s": round(dispatch_solve_s, 3),
+        "accounted_fraction": round(
+            totals["solve_s"] / dispatch_solve_s, 4)
+        if dispatch_solve_s > 0 else None,
+        "pipeline": bool(pipeline),
+        "max_inflight": int(max_inflight),
+    }
+    if iters_all:
+        it = np.concatenate(iters_all)
+        out["iters"] = {"p50": int(np.percentile(it, 50)),
+                        "p99": int(np.percentile(it, 99)),
+                        "max": int(it.max())}
+    return out
+
+
 def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
-                 checkpoint_dir=None, supervisor=None) -> None:
+                 checkpoint_dir=None, supervisor=None,
+                 on_case_solved=None) -> None:
     """Dispatch driver over one or many cases (VERDICT r2 #3/#7).
 
     Replaces the reference's serial sensitivity for-loop
@@ -1407,7 +1657,15 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
     ``PreemptedError``.  With ``checkpoint_dir`` set, a prior manifest is
     consulted first and fully-``done`` cases (fingerprint-verified) are
     reloaded instead of re-dispatched.  The supervisor's watchdog (env
-    ``DERVET_TPU_SOLVE_DEADLINE_S``) bounds each ladder solve."""
+    ``DERVET_TPU_SOLVE_DEADLINE_S``) bounds each ladder solve.
+
+    ``on_case_solved(scenario)`` fires ON THE DISPATCH THREAD the moment
+    a case's LAST window solves (phase-1 cases only; degradation-coupled
+    and quarantined cases never fire) — the hook that lets the caller
+    overlap per-case post-processing with the remaining in-flight solves.
+    At fire time the case's solution is complete and scattered state is
+    NOT yet built; dispatch-global ``solve_metadata`` totals land later,
+    in ``finish_dispatch``."""
     from ..utils.errors import PreemptedError
     from ..utils import supervisor as _sup
     watchdog = (supervisor.watchdog if supervisor is not None
@@ -1451,7 +1709,7 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
 
     try:
         _dispatch_phases(scenarios, backend, solver_opts, watchdog,
-                         _batch_boundary)
+                         _batch_boundary, on_case_solved)
     except PreemptedError as e:
         # graceful shutdown: any batched-up checkpoint state is flushed
         # (only the degradation path batches writes, in strides of 8 —
@@ -1480,7 +1738,7 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
 
 
 def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
-                     _batch_boundary) -> None:
+                     _batch_boundary, on_case_solved=None) -> None:
     """Phases 1 (structure-grouped) and 2 (degradation-stepped) of the
     batched dispatch; split out of ``run_dispatch`` so the preemption
     handler wraps exactly the interruptible region."""
@@ -1510,17 +1768,33 @@ def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
     exact_keys_all: set = set()
     exact_keys_by_case: Dict[int, set] = {}
     # wall-clock phase observables (VERDICT r5 #1): host LP assembly vs
-    # solve (device dispatch + readback for 'jax'; HiGHS for 'cpu').
-    # Cumulative across pipeline threads — overlap means they may sum
-    # past the dispatch wall time.
-    phase_acc = {"assembly_s": 0.0, "solve_s": 0.0}
+    # solve (device dispatch + readback for 'jax'; HiGHS for 'cpu'),
+    # plus the per-group solve LEDGER that decomposes the solve phase
+    # into named device-traffic line items.  Cumulative across pipeline
+    # threads — overlap means they may sum past the dispatch wall time.
+    phase_acc = {"assembly_s": 0.0, "solve_s": 0.0, "stage_s": 0.0}
+    ledger_entries: list = []
     import threading
     phase_lock = threading.Lock()    # solve_only runs in pool workers
+    pipeline_on = backend != "cpu" and _pipeline_enabled()
+    # cases whose LAST window just solved, announced to the caller so
+    # per-case post-processing overlaps the remaining in-flight solves
+    _case_solved_fired: set = set()
 
-    def solve_only(key, items):
+    def _maybe_case_solved(s) -> None:
+        if on_case_solved is None or id(s) in _case_solved_fired:
+            return
+        if s.quarantine is not None or not s.opt_engine or s._degrading:
+            return
+        if all(ctx.label in s._solved for ctx in s.windows):
+            _case_solved_fired.add(id(s))
+            on_case_solved(s)
+
+    def solve_only(key, items, staged=None):
         t0 = time.perf_counter()
         out = items, resolve_group(items, backend, solver_opts,
-                                   key=key, cache=cache, watchdog=watchdog)
+                                   key=key, cache=cache, watchdog=watchdog,
+                                   staged=staged, ledger=ledger_entries)
         dt_ = time.perf_counter() - t0
         with phase_lock:
             phase_acc["solve_s"] += dt_
@@ -1538,6 +1812,7 @@ def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
                 [e[0] for e in entries], [e[1] for e in entries],
                 [e[2] for e in entries], [e[3] for e in entries],
                 [e[4] for e in entries], backend)
+            _maybe_case_solved(order[sid])
 
     def split_exact(members):
         """Build a cheap group's LPs and split by the exact byte-level
@@ -1573,7 +1848,13 @@ def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
             exact_keys_by_case.setdefault(id(item[0]), set()).add(k)
         return subgroups
 
-    if backend == "cpu":
+    max_inflight = 0
+    if backend == "cpu" or not pipeline_on:
+        # the exact-CPU path, and the strict serial reference mode
+        # (DERVET_TPU_PIPELINE=0): assemble, solve, scatter one subgroup
+        # at a time on this thread — no staging, no overlap.  Grouping
+        # and batch contents are IDENTICAL to the pipeline's, so results
+        # are byte-identical; tests assert the pipeline against this path.
         while groups:
             _, members = groups.popitem()
             for k, its in split_exact(members).items():
@@ -1605,24 +1886,32 @@ def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
         import concurrent.futures as cf
         import os
         import jax
-        # depth 1 still pipelines: the MAIN thread assembles group i+1
-        # while the single worker drives group i's solve.  Deeper
-        # pipelines only pay off with spare HOST cores — three concurrent
-        # solve drivers on a 1-CPU host fought over the GIL for the
-        # stack/readback work and measured ~2x the serial solve time
-        # (dispatch_solve_s 35s cumulative vs 10s serial, r5)
-        max_inflight = 1 if len(jax.devices()) > 1 else \
-            max(1, min(3, (os.cpu_count() or 1) - 1))
+        # the r6 pipeline moves the r5-measured GIL-contended host work
+        # OFF the workers: stacking + the host->device upload are STAGED
+        # on this thread at submit time (jax.device_put is async — the
+        # transfer of group i+1 double-buffers under group i's in-flight
+        # solve), and the workers' readback is one fused device_get per
+        # group — so a worker thread is left holding only the blocking
+        # status fetches, which release the GIL while the chip computes,
+        # and ≥2 in-flight groups keep the device queue full through
+        # each other's host round trips (see _pipeline_depth)
+        max_inflight = _pipeline_depth(len(jax.devices()) > 1)
         with cf.ThreadPoolExecutor(max_workers=max_inflight) as pool:
             futs = collections.deque()
             while groups:
                 _, members = groups.popitem()
                 for k, its in split_exact(members).items():
-                    futs.append(pool.submit(solve_only, k, its))
-                while len(futs) > max_inflight:
-                    items, result = futs.popleft().result()
-                    scatter(items, result)
-                    _batch_boundary()
+                    t0 = time.perf_counter()
+                    staged = stage_group_data(its, solver_opts)
+                    phase_acc["stage_s"] += time.perf_counter() - t0
+                    futs.append(pool.submit(solve_only, k, its, staged))
+                    # drain INSIDE the submit loop: in-flight work (and
+                    # staged device buffers) stay bounded even when one
+                    # cheap group splits into many exact subgroups
+                    while len(futs) > max_inflight:
+                        items, result = futs.popleft().result()
+                        scatter(items, result)
+                        _batch_boundary()
             while futs:
                 items, result = futs.popleft().result()
                 scatter(items, result)
@@ -1646,9 +1935,12 @@ def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
             items = guard_items(items)
             if not items:
                 continue
+            t0 = time.perf_counter()
             xs, objs, ok, diags = resolve_group(items, backend, solver_opts,
                                                 key=key, cache=cache,
-                                                watchdog=watchdog)
+                                                watchdog=watchdog,
+                                                ledger=ledger_entries)
+            phase_acc["solve_s"] += time.perf_counter() - t0
             for (s, ctx, lp), x, o, k, dg in zip(items, xs, objs, ok, diags):
                 s.apply_subgroup([(ctx, lp)], [x], [o], [k], [dg], backend)
                 if s.quarantine is not None:
@@ -1659,6 +1951,8 @@ def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
         deg = [s for s in deg
                if s.quarantine is None and s._deg_pos < len(s._pending)]
 
+    ledger = summarize_solve_ledger(ledger_entries, phase_acc["solve_s"],
+                                    pipeline_on, max_inflight)
     for s in scenarios:
         # observable for the solver cache: a degradation year must show
         # builds == distinct structures (typically 3 month lengths), not
@@ -1670,9 +1964,11 @@ def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
         s.solve_metadata["dispatch_assembly_s"] = round(
             phase_acc["assembly_s"], 3)
         s.solve_metadata["dispatch_solve_s"] = round(phase_acc["solve_s"], 3)
+        s.solve_metadata["dispatch_stage_s"] = round(phase_acc["stage_s"], 3)
         s.solve_metadata["structure_groups_total"] = len(
             exact_keys_by_case.get(id(s), ()))
         s.solve_metadata["dispatch_groups_total"] = len(exact_keys_all)
+        s.solve_metadata["solve_ledger"] = ledger
         s.finish_dispatch()
 
 

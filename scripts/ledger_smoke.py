@@ -1,0 +1,70 @@
+"""CI smoke: the sensitivity-fanout leg on the cpu backend, pipeline on.
+
+Runs a small synthetic sensitivity fan-out through the REAL batched
+dispatch pipeline (``run_dispatch(backend="jax")`` on a CPU XLA device —
+no chip required) and asserts the run publishes a well-formed
+``solve_ledger``: schema-checked, and with line items summing to within
+10% of the measured ``dispatch_solve_s``.  This is the no-hardware
+analogue of the BENCH acceptance gate on ``legs.sensitivity_fanout.
+solve_ledger``, so a schema or accounting regression fails CI instead of
+surfacing in the next bench artifact.
+
+Env knobs: SMOKE_CASES (default 3), SMOKE_MONTHS (default 2).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+# runnable both as `python scripts/ledger_smoke.py` from a checkout and
+# against an installed package
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+# force the CPU platform BEFORE any backend is touched (same pattern as
+# tests/conftest.py — some environments pre-import jax with a TPU backend)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    from dervet_tpu.benchlib import (synthetic_sensitivity_cases,
+                                     validate_solve_ledger)
+    from dervet_tpu.scenario.scenario import (MicrogridScenario,
+                                              run_dispatch)
+
+    n_cases = int(os.environ.get("SMOKE_CASES", "3"))
+    months = int(os.environ.get("SMOKE_MONTHS", "2"))
+    os.environ[
+        "DERVET_TPU_PIPELINE"] = "1"   # the smoke tests the pipeline path
+    scens = [MicrogridScenario(c)
+             for c in synthetic_sensitivity_cases(n_cases, months=months)]
+    run_dispatch(scens, backend="jax")
+
+    ledger = scens[0].solve_metadata["solve_ledger"]
+    validate_solve_ledger(ledger)
+    if ledger["pipeline"] is not True:
+        raise AssertionError("pipeline was not enabled for the smoke run")
+    af = ledger["accounted_fraction"]
+    if af is None or abs(af - 1.0) > 0.10:
+        raise AssertionError(
+            f"ledger line items sum to {af} of dispatch_solve_s "
+            "(acceptance: within 10%)")
+    n_solved = sum(len(s.objective_values) for s in scens)
+    expected = sum(len(s.windows) for s in scens)
+    if n_solved != expected:
+        raise AssertionError(
+            f"{n_solved}/{expected} windows solved")
+    print(json.dumps({
+        "smoke": "solve_ledger", "ok": True, "cases": n_cases,
+        "windows_solved": n_solved, "groups": len(ledger["groups"]),
+        "accounted_fraction": af,
+        "totals": ledger["totals"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

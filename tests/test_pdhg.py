@@ -408,6 +408,29 @@ class TestBandedOp:
         assert op.ell is not None and op.wide_w is None
         self._check(op_k, "BandedOp")
 
+    def test_wide_row_cap_counts_selector_bytes(self):
+        """The wide-row pair is TWO dense blocks — the (r, n) values AND
+        the (m, r) selector: a TALL matrix with a few wide rows must fall
+        back to the ELL residual once the selector alone would blow the
+        byte cap, or every scan-path matvec pays an m x r dense matmul
+        (ADVICE r5).  The shape here passes the OLD values-only cap and
+        fails the corrected one."""
+        import scipy.sparse as sp
+        from dervet_tpu.ops.pdhg import WIDE_MAX_BYTES, make_op
+        rng = np.random.default_rng(5)
+        n = 20_000
+        r = 30
+        m = n + r
+        assert r * n * 8 <= WIDE_MAX_BYTES < r * (n + m) * 8
+        diag = sp.eye(n, n, format="coo")
+        wide = sp.coo_matrix(
+            (np.ones(100 * r),
+             (np.repeat(np.arange(r), 100),
+              rng.integers(0, n, 100 * r))), shape=(r, n))
+        op_k = sp.vstack([diag, wide]).tocsr()
+        op = make_op(op_k)
+        assert op.wide_w is None and op.ell is not None
+
     def test_unstructured_falls_back_to_ell(self):
         import scipy.sparse as sp
         R = sp.random(1500, 4000, density=0.002, random_state=3)

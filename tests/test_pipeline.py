@@ -1,0 +1,258 @@
+"""Overlapped-dispatch pipeline tests (perf PR r6).
+
+The dispatch pipeline (staged uploads, overlapped group solves, fused
+readback, early per-case completion) must be a pure EXECUTION-ORDER
+optimization: grouping, batch contents, and solver inputs are identical
+to the strict serial path, so results are byte-identical — asserted
+here, not trusted.  The per-group solve ledger is the other contract:
+every dispatch publishes a schema-valid decomposition of the solve phase
+whose line items sum to the measured ``dispatch_solve_s``.
+"""
+import numpy as np
+import pytest
+
+from dervet_tpu.benchlib import (synthetic_sensitivity_cases,
+                                 validate_solve_ledger)
+from dervet_tpu.scenario.scenario import (MicrogridScenario,
+                                          _stack_group_data, run_dispatch,
+                                          stage_group_data)
+
+
+def _fanout_scenarios(n_cases=3, months=2):
+    return [MicrogridScenario(c)
+            for c in synthetic_sensitivity_cases(n_cases, months=months)]
+
+
+@pytest.fixture(scope="module")
+def pipelined():
+    """One small fan-out dispatched through the pipeline, with the
+    case-completion hook recording its firings."""
+    import os
+    os.environ.pop("DERVET_TPU_PIPELINE", None)   # default: pipeline on
+    scens = _fanout_scenarios()
+    fired = []
+    run_dispatch(scens, backend="jax",
+                 on_case_solved=lambda s: fired.append(s.case.case_id))
+    return scens, fired
+
+
+@pytest.fixture(scope="module")
+def serial():
+    """The identical fan-out through the strict serial reference path."""
+    import os
+    os.environ["DERVET_TPU_PIPELINE"] = "0"
+    try:
+        scens = _fanout_scenarios()
+        run_dispatch(scens, backend="jax")
+    finally:
+        os.environ.pop("DERVET_TPU_PIPELINE", None)
+    return scens
+
+
+class TestByteIdentical:
+    def test_objectives_identical(self, pipelined, serial):
+        for sp, ss in zip(pipelined[0], serial):
+            assert sp.objective_values.keys() == ss.objective_values.keys()
+            for label in sp.objective_values:
+                bp = sp.objective_values[label]
+                bs = ss.objective_values[label]
+                assert bp.keys() == bs.keys()
+                for col in bp:
+                    # byte-identical, not approx: the pipeline may not
+                    # change WHAT is solved, only when
+                    assert bp[col] == bs[col], (label, col)
+
+    def test_solution_arrays_identical(self, pipelined, serial):
+        for sp, ss in zip(pipelined[0], serial):
+            assert set(sp._solution) == set(ss._solution)
+            for name in sp._solution:
+                assert np.array_equal(sp._solution[name],
+                                      ss._solution[name]), name
+
+    def test_results_csv_identical(self, pipelined, serial, tmp_path):
+        """The full results CSV surface — what a user actually reads —
+        is byte-identical between the pipelined and serial paths."""
+        from dervet_tpu.results.result import CaseResult
+        sp, ss = pipelined[0][0], serial[0]
+        for s, sub in ((sp, "pipe"), (ss, "serial")):
+            inst = CaseResult(s)
+            inst.collect_results()
+            inst.calculate_cba()
+            inst.save_as_csv(tmp_path / sub)
+        pipe_files = sorted(p.name for p in (tmp_path / "pipe").iterdir())
+        serial_files = sorted(p.name
+                              for p in (tmp_path / "serial").iterdir())
+        assert pipe_files == serial_files and pipe_files
+        for name in pipe_files:
+            a = (tmp_path / "pipe" / name).read_bytes()
+            b = (tmp_path / "serial" / name).read_bytes()
+            assert a == b, f"{name} differs between pipelined and serial"
+
+    def test_pipeline_flag_recorded(self, pipelined, serial):
+        assert pipelined[0][0].solve_metadata["solve_ledger"]["pipeline"] \
+            is True
+        assert serial[0].solve_metadata["solve_ledger"]["pipeline"] is False
+
+
+class TestSolveLedger:
+    def test_schema_valid(self, pipelined):
+        for s in pipelined[0]:
+            validate_solve_ledger(s.solve_metadata["solve_ledger"])
+
+    def test_line_items_sum_to_dispatch_solve(self, pipelined):
+        """Acceptance gate: ledger line items sum to within 10% of the
+        measured dispatch_solve_s, and each jax entry's in-wall split
+        reconstructs its own wall."""
+        led = pipelined[0][0].solve_metadata["solve_ledger"]
+        af = led["accounted_fraction"]
+        assert af is not None and abs(af - 1.0) <= 0.10, led
+        for g in led["groups"]:
+            if g.get("backend") == "cpu":
+                continue
+            parts = g["stack_s"] + g["h2d_s"] + g["sync_wait_s"] \
+                + g["result_fetch_s"] + g["other_s"]
+            assert parts == pytest.approx(g["solve_s"], abs=2e-3), g
+
+    def test_ledger_covers_all_windows(self, pipelined):
+        scens = pipelined[0]
+        led = scens[0].solve_metadata["solve_ledger"]
+        n_windows = sum(len(s.windows) for s in scens)
+        initial = [g for g in led["groups"] if g.get("rung") == "initial"]
+        assert sum(g["batch"] for g in initial) == n_windows
+        assert led["totals"]["windows"] >= n_windows
+        assert "iters" in led and led["iters"]["p50"] > 0
+
+    def test_device_traffic_observables_present(self, pipelined):
+        led = pipelined[0][0].solve_metadata["solve_ledger"]
+        tot = led["totals"]
+        assert tot["dispatches"] > 0
+        assert tot["chunks"] > 0
+        assert tot["readbacks"] > 0
+        assert tot["compile_events"] > 0
+        assert tot["h2d_bytes"] > 0
+        assert tot["result_bytes"] > 0
+
+    def test_ledger_on_cpu_backend(self):
+        """The cpu backend publishes a (smaller) ledger too — so the CI
+        smoke and the sensitivity leg's serial-CPU comparison carry the
+        same observable."""
+        scens = _fanout_scenarios(n_cases=2, months=1)
+        run_dispatch(scens, backend="cpu")
+        led = scens[0].solve_metadata["solve_ledger"]
+        assert led["pipeline"] is False
+        assert all(g["backend"] == "cpu" for g in led["groups"])
+        assert abs(led["accounted_fraction"] - 1.0) <= 0.10
+
+
+class TestCaseCompletionHook:
+    def test_fires_once_per_case_with_complete_solution(self, pipelined):
+        scens, fired = pipelined
+        assert sorted(fired) == sorted(s.case.case_id for s in scens)
+        # at fire time every window was solved; solutions stayed complete
+        for s in scens:
+            assert {ctx.label for ctx in s.windows} <= s._solved
+
+
+class TestApiOverlapPath:
+    """The api-level overlap machinery (on_case_solved scatter + worker-
+    pool build_instance + late registration in case order + pool
+    shutdown) exercised end-to-end through ``DERVET.solve`` — with
+    ``Params.initialize`` monkeypatched to the synthetic fan-out, so this
+    runs in CI without the reference dataset."""
+
+    def _solve(self, monkeypatch, pipeline: str):
+        import os
+        from dervet_tpu.api import DERVET
+        from dervet_tpu.io.params import Params
+        monkeypatch.setattr(
+            Params, "initialize",
+            classmethod(lambda cls, path, base_path=None, verbose=False:
+                        dict(enumerate(
+                            synthetic_sensitivity_cases(3, months=2)))))
+        monkeypatch.setenv("DERVET_TPU_PIPELINE", pipeline)
+        try:
+            return DERVET("synthetic://fanout").solve(backend="jax")
+        finally:
+            os.environ.pop("DERVET_TPU_PIPELINE", None)
+
+    def test_overlapped_post_matches_serial_csvs(self, monkeypatch,
+                                                 tmp_path):
+        res_p = self._solve(monkeypatch, "1")
+        res_s = self._solve(monkeypatch, "0")
+        assert sorted(res_p.instances) == sorted(res_s.instances) \
+            == [0, 1, 2]
+        # registration order is the cases' original order either way
+        assert list(res_p.instances) == list(res_s.instances)
+        res_p.save_as_csv(tmp_path / "pipe")
+        res_s.save_as_csv(tmp_path / "serial")
+        pipe = sorted(p.name for p in (tmp_path / "pipe").iterdir())
+        serial = sorted(p.name for p in (tmp_path / "serial").iterdir())
+        assert pipe == serial and pipe
+        for name in pipe:
+            if name == "run_health.json":
+                continue   # carries wall-clock retry_seconds
+            a = (tmp_path / "pipe" / name).read_bytes()
+            b = (tmp_path / "serial" / name).read_bytes()
+            assert a == b, f"{name} differs between overlapped and serial"
+        assert res_p.solve_ledger is not None
+        assert res_p.solve_ledger["pipeline"] is True
+        assert res_s.solve_ledger["pipeline"] is False
+
+
+class TestStagedUploads:
+    def test_staged_solve_matches_host_path(self):
+        """stage_group_data's stacked+uploaded arrays produce bit-equal
+        solver results vs handing the solver host arrays (the staged
+        upload is a transport change only)."""
+        from dervet_tpu.ops.pdhg import CompiledLPSolver
+        from tests.test_pdhg import battery_like_lp
+
+        lp = battery_like_lp(T=48)
+        rng = np.random.default_rng(3)
+        lps = []
+        for i in range(4):
+            import copy
+            lp_i = copy.deepcopy(lp)
+            lp_i.c[:] = lp.c * (1.0 + 0.1 * rng.standard_normal(lp.n))
+            lps.append(lp_i)
+        items = [(None, None, lp_i) for lp_i in lps]
+        staged = stage_group_data(items, None, force=True)
+        assert staged is not None
+        assert staged.h2d_bytes > 0
+        solver = CompiledLPSolver(lp)
+        res_staged = solver.solve(c=staged.arrays[0], q=staged.arrays[1],
+                                  l=staged.arrays[2], u=staged.arrays[3])
+        C, Q, L, U = _stack_group_data(
+            lps, np.dtype(solver.opts.dtype), multi_dev=False)
+        res_host = solver.solve(c=C, q=Q, l=L, u=U)
+        np.testing.assert_array_equal(np.asarray(res_staged.x),
+                                      np.asarray(res_host.x))
+        np.testing.assert_array_equal(np.asarray(res_staged.obj),
+                                      np.asarray(res_host.obj))
+
+    def test_identical_vectors_collapse_to_shared(self):
+        """The 1-D dedup collapse survives in the staging path: vectors
+        identical across the group stay 1-D (no (B, n) block upload)."""
+        from tests.test_pdhg import battery_like_lp
+        lp = battery_like_lp(T=24)
+        lps = [lp, lp, lp]
+        C, Q, L, U = _stack_group_data(lps, np.dtype(np.float32),
+                                       multi_dev=False)
+        assert C.ndim == Q.ndim == L.ndim == U.ndim == 1
+
+    def test_solve_stats_populated(self):
+        """CompiledLPSolver.last_stats carries the ledger raw material."""
+        from dervet_tpu.ops.pdhg import CompiledLPSolver
+        from tests.test_pdhg import battery_like_lp
+        lp = battery_like_lp(T=24)
+        solver = CompiledLPSolver(lp)
+        res = solver.solve()
+        assert bool(np.asarray(res.converged))
+        st = solver.last_stats
+        assert st is not None
+        assert st.dispatches > 0 and st.chunks > 0 and st.readbacks > 0
+        assert st.h2d_bytes > 0       # c/q/l/u defaults were host arrays
+        assert st.compile_events > 0
+        # a second solve of the same shape recompiles nothing
+        solver.solve()
+        assert solver.last_stats.compile_events == 0

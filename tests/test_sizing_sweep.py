@@ -71,3 +71,19 @@ def test_sweep_rejects_sizing_cases(case):
             keys["ene_max_rated"] = 0   # would add a size variable
     with pytest.raises(ParameterError):
         sizing_sweep(c, [500], [0])
+
+
+def test_sweep_hard_errors_on_binary_formulation():
+    """binary=1 + sizing sweep is a hard error, matching the reference's
+    binary+sizing prohibition (MicrogridPOI.py:132-147) — the former
+    warning let a 400-candidate sweep silently rank candidates on LP-
+    relaxation objectives the binary formulation never attains
+    (VERDICT r5 weak #3).  Synthetic case: no reference data needed."""
+    from dervet_tpu.benchlib import synthetic_case
+    c = synthetic_case()
+    c.scenario["binary"] = 1
+    c.scenario["allow_partial_year"] = True
+    ts = c.datasets.time_series
+    c.datasets.time_series = ts.iloc[: 24 * 7]
+    with pytest.raises(ParameterError, match="binary"):
+        sizing_sweep(c, [500], [1000])
