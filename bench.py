@@ -387,6 +387,23 @@ def sensitivity_leg() -> dict:
         f"serial cpu {t_cpu:.1f}s ({t_cpu / t_jax_warm:.2f}x warm); worst "
         f"per-case NPV rel err {worst:.2e} (gate 1e-2): "
         f"{'OK' if ok else 'FAIL'}")
+    cert = (ledger or {}).get("certification")
+    if cert and cert.get("enabled"):
+        # numerical trust line: the certification + shadow overhead the
+        # warm product leg actually paid, and the proof every window
+        # carried an accepted float64 certificate (PERF.md "Numerical
+        # trust" section cites these numbers)
+        from dervet_tpu.ops.certify import validate_certification
+        validate_certification(cert)
+        cw = cert["windows"]
+        n_cert = cert["windows_certified"]
+        log("bench[sensitivity]: certification — "
+            f"{n_cert} window(s) certified ({cw['certified_loose']} "
+            f"loose, {cw['rejected']} rejected) in {cert['cert_s']}s "
+            f"({1e3 * cert['cert_s'] / max(n_cert, 1):.2f} ms/window); "
+            f"shadow drift max {cert['shadow']['rel_diff_max']:.1e} rel "
+            f"over {cert['shadow']['n']} window(s) "
+            f"({cert['shadow']['shadow_s']}s)")
     if ledger is not None:
         tot = ledger.get("totals", {})
         log("bench[sensitivity]: solve ledger — "

@@ -172,7 +172,10 @@ class DERVET:
         report = run_health_report(
             {key: getattr(s, "health", {}) for key, s in scenarios.items()},
             {key: s.quarantine for key, s in scenarios.items()
-             if s.quarantine is not None})
+             if s.quarantine is not None},
+            certification_by_case={
+                key: getattr(s, "certification", None)
+                for key, s in scenarios.items()})
         results.run_health = report
         log_health_report(report)
         # cases the hook never saw (degradation-coupled, manifest-resumed,
@@ -198,6 +201,22 @@ class DERVET:
         finally:
             if post_pool is not None:
                 post_pool.shutdown(wait=True)
+        # physical-invariant audit (numerical trust layer): every
+        # collected case's assembled results re-checked against the SOE
+        # recurrence / seam pins / rating bounds / POI balance /
+        # objective-component reconciliation (ops/certify.audit_case,
+        # run inside collect_results) — aggregated into run_health so the
+        # persisted report carries the verdict
+        from .ops.certify import aggregate_audits
+        audit = aggregate_audits(
+            {key: getattr(inst, "invariant_audit", None)
+             for key, inst in results.instances.items()})
+        report["invariant_audit"] = audit
+        if not audit["ok"]:
+            TellUser.warning(
+                "invariant audit FAILED for case(s) "
+                f"{sorted(audit['failing'])} — see run_health.json "
+                "invariant_audit for the violated checks")
         results.sensitivity_summary()
         done = time.time()
         # phase split observable (VERDICT r5 #1): params+case prep /

@@ -38,12 +38,18 @@ def class_summary(cases: Dict) -> None:
     TellUser.info("\n".join(lines))
 
 
-def run_health_report(health_by_case: Dict, quarantined: Dict) -> Dict:
+def run_health_report(health_by_case: Dict, quarantined: Dict,
+                      certification_by_case: Dict = None) -> Dict:
     """Aggregate per-case window-health counters into one run report.
 
     ``health_by_case``: case key -> the scenario's ``health`` dict.
     ``quarantined``: case key -> quarantine record (reason/window) for
-    cases dropped by the failure-isolation layer."""
+    cases dropped by the failure-isolation layer.
+    ``certification_by_case`` (optional): case key -> the scenario's
+    ``certification`` dict (numerical trust layer) — aggregated into a
+    ``certification`` section: per-window float64 certificate counts,
+    rejected-then-recovered recoveries, shadow-solve drift stats, and
+    the active tolerance policy."""
     totals = {k: 0 for k in HEALTH_KEYS}
     retry_s = 0.0
     watchdog = 0
@@ -54,7 +60,7 @@ def run_health_report(health_by_case: Dict, quarantined: Dict) -> Dict:
         # event counter, not a disjoint window bucket: a timed-out solve's
         # windows still land in retried/cpu_fallback/quarantined
         watchdog += int(h.get("watchdog_timeouts", 0))
-    return {
+    report = {
         "windows": totals,
         "retry_seconds": round(retry_s, 3),
         "watchdog_timeouts": watchdog,
@@ -68,6 +74,11 @@ def run_health_report(health_by_case: Dict, quarantined: Dict) -> Dict:
                                              "watchdog_timeouts")}
                      for k, h in health_by_case.items()},
     }
+    if certification_by_case is not None:
+        from ..ops import certify
+        report["certification"] = certify.aggregate_certification(
+            certification_by_case)
+    return report
 
 
 def log_health_report(report: Dict) -> None:
@@ -83,6 +94,19 @@ def log_health_report(report: Dict) -> None:
     if report.get("watchdog_timeouts"):
         msg += (f"; {report['watchdog_timeouts']} solve(s) abandoned at "
                 "the watchdog deadline")
+    cert = report.get("certification")
+    if cert and cert.get("enabled"):
+        cw = cert["windows"]
+        msg += (f"; certification: {cert['windows_certified']} window(s) "
+                f"certified ({cw['certified_loose']} loose)")
+        if cw["rejected"]:
+            msg += (f", {cw['rejected']} rejection(s) "
+                    f"[{cw['rejected_then_recovered']} recovered, "
+                    f"{cw['rejected_final']} final]")
+        sh = cert.get("shadow") or {}
+        if sh.get("n"):
+            msg += (f"; shadow drift max {sh['rel_diff_max']:.1e} rel "
+                    f"over {sh['n']} window(s)")
     if report["cases_quarantined"]:
         msg += (f"; quarantined case(s) "
                 f"{', '.join(report['cases_quarantined'])}: "

@@ -36,6 +36,14 @@ from .base import ValueStream
 
 DA_PRICE_COL = "DA Price ($/kWh)"
 
+# objective_values column carrying the deterministic tiebreak-tilt term
+# (see MarketService.TIEBREAK_EPS): reported EXPLICITLY so the labeled
+# per-stream components reconcile exactly — "Total Objective" subtracts
+# this term (the tilt is a solver-only vertex selector, not a revenue),
+# so sum(labeled components excluding this column) == Total Objective
+# to float64 precision, and the invariant audit asserts it
+TILT_LABEL = "Tiebreak Tilt"
+
 
 class MarketService(ValueStream):
     """Shared machinery for capacity-bid market services."""
@@ -73,11 +81,13 @@ class MarketService(ValueStream):
     #: TIEBREAK_EPS x rank on each service's OPTIMIZATION price makes the
     #: split unique while perturbing each tilted stream's price by at most
     #: TIEBREAK_EPS x max(rank) = 4e-3 relative (rank 4 = LF); reporting
-    #: (proforma/NPV) always uses the untilted price.  Because the labeled
-    #: per-stream revenue vectors exclude the tilt (it rides as a separate
-    #: unlabeled cost below), the labeled objective components need NOT
-    #: sum to the tilted "Total Objective" — the residual is exactly the
-    #: tilt term.  1e-3, not 1e-4: the tilt gradient must dominate PDHG's
+    #: (proforma/NPV) always uses the untilted price.  The labeled
+    #: per-stream revenue vectors exclude the tilt; the tilt itself is
+    #: reported as the explicit TILT_LABEL column and SUBTRACTED from the
+    #: reported "Total Objective" (scenario.apply_subgroup), so the
+    #: labeled components sum exactly to the reported total — the solver
+    #: optimizes the tilted objective, reporting publishes the untilted
+    #: one.  1e-3, not 1e-4: the tilt gradient must dominate PDHG's
     #: convergence tolerance (eps_rel 1e-4) for the iterate to actually
     #: land on the preferred vertex — at 1e-4 the split still wandered
     #: ~1.5% of a column's scale (input 008, r5).
@@ -104,11 +114,13 @@ class MarketService(ValueStream):
             refs[direction] = ref
             # capacity revenue (negative cost).  The labeled (reported)
             # vector stays UNTILTED — objective_values must not be
-            # biased per stream — while the tilt rides as a separate
-            # unlabeled cost so only the optimizer sees it.
+            # biased per stream — while the tilt rides under its own
+            # TILT_LABEL column: only the optimizer pays it, and the
+            # reported total subtracts it back out (apply_subgroup).
             b.add_cost(ref, -price * scale, label=self.tag)
             if tilt != 1.0:
-                b.add_cost(ref, price * scale * (1.0 - tilt))
+                b.add_cost(ref, price * scale * (1.0 - tilt),
+                           label=TILT_LABEL)
             # expected-throughput energy settlement at DA price: up sells
             # energy (revenue), down absorbs energy (cost); k is kWh per
             # kW-hr of award so the single dt in `scale` converts the

@@ -109,6 +109,9 @@ class CaseResult:
         self.payback_df: Optional[pd.DataFrame] = None
         self.cost_benefit_df: Optional[pd.DataFrame] = None
         self.drill_down_dict: Dict[str, pd.DataFrame] = {}
+        # physical-invariant audit verdict (ops/certify.audit_case),
+        # filled by collect_results and aggregated into run_health
+        self.invariant_audit: Optional[Dict] = None
 
     # ------------------------------------------------------------------
     def collect_results(self) -> None:
@@ -131,6 +134,18 @@ class CaseResult:
             if report is not None:
                 self.drill_down_dict[f"degradation_data_{der.name}"] = report
         self._dispatch_drill_downs()
+        # physical-invariant audit over the assembled results (numerical
+        # trust layer): a scrambled scatter or overlapped-post race shows
+        # up here even when every per-window certificate passed.  Never
+        # lets an audit bug break result collection — an audit failure is
+        # a REPORT, the results themselves still ship.
+        from ..ops import certify
+        try:
+            self.invariant_audit = certify.audit_case(
+                s, self.time_series_data)
+        except Exception as e:
+            TellUser.warning(f"invariant audit errored: {e}")
+            self.invariant_audit = {"ok": False, "error": str(e)}
 
     def _dispatch_drill_downs(self) -> None:
         """Hour x day pivots + peak-day summary (reference output set:

@@ -26,8 +26,9 @@ from ..models.der.base import DER
 from ..models.der.ess import Battery
 from ..models.streams.base import ValueStream
 from ..models.streams.da import DAEnergyTimeShift
+from ..models.streams.markets import TILT_LABEL
 from ..ops.lp import LP, LPBuilder
-from ..ops import cpu_ref
+from ..ops import certify, cpu_ref
 from ..utils import faultinject
 from ..utils.errors import (AggregatedSolverError, MonthlyDataError,
                             ParameterError, SolverError, TellUser,
@@ -206,6 +207,11 @@ class MicrogridScenario:
         # through the ladder for the run-health report
         self.quarantine: Optional[Dict[str, Any]] = None
         self.health: Dict[str, Any] = _new_health()
+        # numerical trust layer: per-window float64 certification counts
+        # (ops/certify.py) + deterministic shadow-solve drift stats
+        self.certification: Dict[str, Any] = certify.new_certification(
+            certify.policy_from_env().enabled)
+        self._shadow_labels: set = set()
 
     # ------------------------------------------------------------------
     def build_window_lp(self, ctx: WindowContext, annuity_scalar: float = 1.0,
@@ -380,6 +386,9 @@ class MicrogridScenario:
         self._ckpt_backlog = 0
         self.quarantine = None
         self.health = _new_health()
+        self.certification = certify.new_certification(
+            certify.policy_from_env().enabled)
+        self._shadow_labels = set()
         self._scattered = False
         self._solution: Dict[str, np.ndarray] = {}
         self._solved: set = set()
@@ -422,6 +431,12 @@ class MicrogridScenario:
             if not items0:
                 return          # sizing inputs rejected: case quarantined
             health_snap = dict(self.health)
+            # the sizing pre-solve is provisional (the window re-solves at
+            # frozen integer ratings below): roll its certificate counts
+            # back with the health buckets so it is certified exactly once
+            cert_snap = {k: self.certification[k]
+                         for k in certify.CERT_COUNT_KEYS}
+            cert_win_snap = dict(self.certification["windows"])
             xs, objs, ok, diags = resolve_group(items0, "cpu", solver_opts)
             self.apply_subgroup(pairs, xs, objs, ok, diags, "cpu",
                                 freeze_sizes=True)
@@ -438,6 +453,9 @@ class MicrogridScenario:
             # wall time genuinely spent is kept)
             health_snap["retry_seconds"] = self.health["retry_seconds"]
             self.health = health_snap
+            for k in certify.CERT_COUNT_KEYS:
+                self.certification[k] = cert_snap[k]   # cert_s kept
+            self.certification["windows"] = cert_win_snap
             self._solved.discard(ctx0.label)
             # capacity-dependent requirements (Reliability min-SOE, RA
             # qualifying capacity) were computed against zero ratings;
@@ -483,6 +501,9 @@ class MicrogridScenario:
         self._ckpt_backlog = 0
         self.quarantine = None
         self.health = _new_health()
+        self.certification = certify.new_certification(
+            certify.policy_from_env().enabled)
+        self._shadow_labels = set()
         self._scattered = False
         self._solution = solution
         self._solved = solved
@@ -628,6 +649,7 @@ class MicrogridScenario:
             "batched_solves": self._n_solves,
             "n_windows": len(self.windows),
             "health": dict(self.health),
+            "certification": dict(self.certification),
             "quarantined": self.quarantine,
         })
 
@@ -681,23 +703,33 @@ class MicrogridScenario:
             # loosened PDHG settings don't read first-order noise as
             # cheating and forfeit the batched path
             bin_tol = max(getattr(solver_opts, "eps_rel", 0.0) or 0.0, 1e-4)
+            policy = certify.policy_from_env()
             for i, lp in enumerate(lps):
                 if lp.integrality is None:
                     continue
-                # binary windows were NOT bucketed in resolve_group — the
-                # outcome of the binary check / MILP rescue below is the
-                # window's final health bucket (failures join `failed`
-                # and count as quarantined)
+                # binary windows were NOT bucketed (or certified) in
+                # resolve_group — the outcome of the binary check / MILP
+                # rescue below is the window's final health bucket
+                # (failures join `failed` and count as quarantined), and
+                # the FINAL solution is what gets the float64 certificate
+                relax_rejected = False
                 if ok[i] and cpu_ref.binary_feasible(lp, xs[i], tol=bin_tol):
-                    with _health_lock:
-                        self.health["clean"] += 1
-                    continue
-                # relaxation cheated (fractional on/off) — or failed to
-                # converge at all, which is the wrong abort criterion for
-                # an integral LP: either way the exact MILP rescues it
+                    cert = (_certify_and_record(self, ctxs[i].label, lp,
+                                                xs[i], objs[i], policy)
+                            if policy.enabled else None)
+                    if cert is None or cert.accepted:
+                        with _health_lock:
+                            self.health["clean"] += 1
+                        continue
+                    relax_rejected = True
+                # relaxation cheated (fractional on/off), failed to
+                # converge, or its solution was rejected by the float64
+                # certifier: either way the exact MILP rescues it
                 TellUser.info(
                     f"window {ctxs[i].label}: "
-                    + ("relaxation exploits fractional on/off"
+                    + ("certifier rejected the relaxation solution"
+                       if relax_rejected else
+                       "relaxation exploits fractional on/off"
                        if ok[i] else "relaxation did not converge")
                     + "; re-solving as exact MILP")
                 was_unconverged = not ok[i]
@@ -705,6 +737,23 @@ class MicrogridScenario:
                 xs[i], objs[i] = res.x, res.obj
                 ok[i] = res.status == 0
                 diags[i] = res.message or diags[i]
+                if ok[i] and policy.enabled:
+                    cert = _certify_and_record(self, ctxs[i].label, lp,
+                                               xs[i], objs[i], policy,
+                                               was_rejected=relax_rejected)
+                    if not cert.accepted:
+                        ok[i] = False
+                        diags[i] = (f"{certify.REJECT_DIAG_PREFIX} exact "
+                                    f"MILP solution rejected: {cert.reason}")
+                        with _health_lock:
+                            self.certification["rejected_final"] += 1
+                elif not ok[i] and relax_rejected:
+                    # the cert-rejected relaxation's MILP rescue failed
+                    # outright: the window's LAST certificate verdict was
+                    # the rejection, so the partition invariant
+                    # (rejections = recovered + final) must count it here
+                    with _health_lock:
+                        self.certification["rejected_final"] += 1
                 if ok[i]:
                     # an unconverged relaxation rescued by the exact MILP
                     # is a CPU-fallback recovery in health terms; a
@@ -719,7 +768,18 @@ class MicrogridScenario:
                 failed.append((ctx, diag))
                 continue
             breakdown = lp.objective_breakdown(x)
-            breakdown["Total Objective"] = float(obj) + lp.c0
+            # the tiebreak tilt is a solver-only vertex selector, not a
+            # revenue: report it as its own explicit column and subtract
+            # it from the total, so the labeled per-stream components sum
+            # EXACTLY to the reported total (the invariant audit asserts
+            # this to 1e-9; closes the ADVICE r5 component-sum finding).
+            # The total is the float64 recompute of c@x, NOT the solver's
+            # f32-accumulated objective — the components are float64 and
+            # an f32 total would leave a ~1e-8 phantom residual.
+            obj64 = float(np.asarray(lp.c, np.float64)
+                          @ np.asarray(x, np.float64))
+            breakdown["Total Objective"] = obj64 + lp.c0 \
+                - breakdown.get(TILT_LABEL, 0.0)
             self.objective_values[ctx.label] = breakdown
             pos = np.searchsorted(self.index, ctx.index[0])
             for name, ref in lp.var_refs.items():
@@ -1012,7 +1072,7 @@ def stage_group_data(items, solver_opts,
 def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
                 key=None, cache: Optional[SolverCache] = None, labels=None,
                 staged: Optional[StagedGroupData] = None, ledger=None,
-                ledger_meta=None):
+                ledger_meta=None, y_sink: Optional[dict] = None):
     """Solve a group of structure-identical LPs.  Backend 'cpu' = exact
     HiGHS per instance; 'jax' = ONE batched PDHG device call, sharded over
     the scenario-axis mesh when more than one accelerator is visible
@@ -1104,6 +1164,11 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
     # needs it (below).
     x_h, obj_h, conv_h, iters_h, pr_h, gap_h, st_h = \
         fetch_result_host(res, stats)
+    if y_sink is not None:
+        # requested only when the certification policy wants the dual
+        # side (DERVET_TPU_CERT_DUAL=1): one extra fused fetch per group;
+        # otherwise y keeps its PR-3 stays-on-device invariant
+        y_sink["y"] = np.asarray(res.y)
     if np.ndim(x_h) == 1:
         statuses = [int(st_h)]
         xs = [np.asarray(x_h)]
@@ -1186,6 +1251,72 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
 # health counters are mutated from the dispatch pipeline's worker threads
 # (a case's windows may ride two concurrently-solving groups)
 _health_lock = threading.Lock()
+
+
+def _certification_of(s) -> Dict[str, Any]:
+    """The scenario's certification counter dict, lazily created — direct
+    ``resolve_group`` callers (tests) may pass scenario stand-ins that
+    carry only ``health``."""
+    c = getattr(s, "certification", None)
+    if c is None:
+        c = certify.new_certification()
+        try:
+            s.certification = c
+        except Exception:
+            pass
+    return c
+
+
+def _certify_and_record(s, label, lp: LP, x, obj, policy,
+                        y=None, was_rejected: bool = False):
+    """Run the float64 certifier on one accepted solution and record the
+    verdict in the case's certification counters.  ``was_rejected`` marks
+    a solution recovered by the escalation ladder after an earlier
+    certificate rejection — an accepted re-certificate then counts the
+    ``rejected_then_recovered`` recovery."""
+    t0 = time.perf_counter()
+    cert = certify.certify_solution(lp, x, obj, policy, y=y)
+    elapsed = time.perf_counter() - t0
+    rec = _certification_of(s)
+    with _health_lock:
+        rec["cert_s"] += elapsed
+        if cert.accepted:
+            rec[cert.verdict] += 1
+            if was_rejected:
+                rec["rejected_then_recovered"] += 1
+        else:
+            rec["rejected"] += 1
+            rec["windows"][str(label)] = cert.as_dict()
+    return cert
+
+
+def _shadow_solve(s, label, lp: LP, obj, policy) -> None:
+    """One deterministic shadow re-solve: the exact CPU (HiGHS) objective
+    vs the batched solver's, recorded as a run-over-run drift statistic
+    in ``certification['shadow']``."""
+    t0 = time.perf_counter()
+    res = cpu_ref.solve_lp_cpu(lp)
+    elapsed = time.perf_counter() - t0
+    rec = _certification_of(s)
+    if res.status != 0 or not np.isfinite(res.obj):
+        TellUser.warning(f"shadow solve of window {label} did not reach "
+                         f"optimality ({res.message}); drift sample "
+                         "skipped")
+        with _health_lock:
+            rec["shadow"]["shadow_s"] += elapsed
+        return
+    rel = abs(float(obj) - res.obj) / (1.0 + abs(res.obj))
+    with _health_lock:
+        certify.record_shadow(rec["shadow"], label, rel)
+        rec["shadow"]["shadow_s"] += elapsed
+    if rel > policy.shadow_warn:
+        TellUser.warning(
+            f"shadow solve of window {label}: batched objective drifts "
+            f"{rel:.2e} rel from the exact CPU answer "
+            f"(threshold {policy.shadow_warn:g})")
+    else:
+        TellUser.info(f"shadow solve of window {label}: objective within "
+                      f"{rel:.2e} rel of the exact CPU answer")
 
 # escalation-ladder rung 1: re-solve failed members with 4x the iteration
 # budget and a 10x-relaxed inaccurate acceptance — PDLP-family solvers have
@@ -1333,6 +1464,11 @@ def resolve_group(items, backend: str, solver_opts, key=None,
     meta = {"rung": "initial", "T": getattr(items[0][1], "T", None),
             "windows": len(items),
             "cases": len({id(s) for (s, _, _) in items})}
+    policy = certify.policy_from_env()
+    # the dual block leaves the device ONLY when the certification policy
+    # asks for dual-side verification (DERVET_TPU_CERT_DUAL=1)
+    y_box: Optional[dict] = ({} if (policy.enabled and policy.check_dual
+                                    and backend != "cpu") else None)
     # the watchdog may ABANDON a wedged solve on a daemon thread; handing
     # solve_group the shared ledger would let that zombie append a
     # full-wall entry after the deadline cut dispatch_solve_s short (or
@@ -1346,7 +1482,8 @@ def resolve_group(items, backend: str, solver_opts, key=None,
         faultinject.maybe_sleep(labels, faultinject.RUNG_SOLVE)
         return solve_group(lps[0], lps, backend, solver_opts, key=key,
                            cache=cache, labels=labels, staged=staged,
-                           ledger=local_ledger, ledger_meta=meta)
+                           ledger=local_ledger, ledger_meta=meta,
+                           y_sink=y_box)
 
     (xs, objs, ok, diags, statuses), timed_out = _guarded_solve(
         watchdog, "initial", lps, labels, _call)
@@ -1363,6 +1500,42 @@ def resolve_group(items, backend: str, solver_opts, key=None,
                 statuses[i] = STATUS_ITER_LIMIT
                 diags[i] = ("fault injection: forced non-convergence at "
                             "rung 'solve'")
+        # corrupt_solution fires AFTER the solver's verdict: the solve
+        # still reports success, only the numbers are wrong — the shape
+        # of failure only the independent certifier below can catch
+        for i, (s, ctx, lp) in enumerate(items):
+            if ok[i]:
+                bad = faultinject.maybe_corrupt(ctx.label, xs[i],
+                                                faultinject.RUNG_SOLVE, plan)
+                if bad is not None:
+                    xs[i] = bad
+    # ---- independent float64 certification of every accepted solution
+    # (ops/certify.py): a certificate rejection drops the member into the
+    # escalation ladder exactly like a solver failure — today's ladder
+    # only fires on solver STATUS, so a wrong-but-"OPTIMAL" solution
+    # would otherwise never be retried
+    cert_rejected: set = set()
+    if policy.enabled:
+        ys = y_box.get("y") if y_box else None
+        if ys is not None and np.ndim(ys) == 1:
+            ys = ys[None]
+        for i, (s, ctx, lp) in enumerate(items):
+            if not ok[i] or (lp.integrality is not None
+                             and backend != "cpu"):
+                # binary relaxations on an accelerated backend are
+                # provisional — apply_subgroup certifies their FINAL x
+                continue
+            cert = _certify_and_record(
+                s, ctx.label, lp, xs[i], objs[i], policy,
+                y=(ys[i] if ys is not None else None))
+            if not cert.accepted:
+                ok[i] = False
+                cert_rejected.add(i)
+                diags[i] = f"{certify.REJECT_DIAG_PREFIX} {cert.reason}"
+                TellUser.warning(
+                    f"window {ctx.label}: solver-accepted solution "
+                    f"REJECTED by the float64 certifier ({cert.reason}); "
+                    "escalating")
     fail_idx = [i for i in range(len(items)) if not ok[i]]
     with _health_lock:
         for i, (s, ctx, lp) in enumerate(items):
@@ -1377,12 +1550,33 @@ def resolve_group(items, backend: str, solver_opts, key=None,
                          else "clean"] += 1
     if fail_idx:
         _escalate(items, fail_idx, xs, objs, ok, diags, statuses,
-                  backend, solver_opts, key, cache, watchdog, ledger=ledger)
+                  backend, solver_opts, key, cache, watchdog, ledger=ledger,
+                  policy=policy, cert_rejected=cert_rejected)
+    if policy.enabled and cert_rejected:
+        # windows whose LAST certificate still rejected after the full
+        # ladder: counted here (their case quarantines in apply_subgroup)
+        with _health_lock:
+            for i in cert_rejected:
+                if not ok[i]:
+                    _certification_of(items[i][0])["rejected_final"] += 1
+    # deterministic shadow-solve drift sample, AFTER the ladder so a
+    # sampled window that was cert-rejected-then-recovered still gets its
+    # cross-check (the drill runs are exactly where it matters most).
+    # Skipped on the cpu backend (the shadow would re-run the identical
+    # solver) and for binary windows (their accepted value here is the
+    # LP relaxation — comparing it against the exact MILP would record
+    # the integrality gap as phantom solver drift).
+    if policy.enabled and backend != "cpu":
+        for i, (s, ctx, lp) in enumerate(items):
+            if ok[i] and lp.integrality is None and \
+                    ctx.label in getattr(s, "_shadow_labels", ()):
+                _shadow_solve(s, ctx.label, lp, objs[i], policy)
     return xs, objs, ok, diags
 
 
 def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
-              solver_opts, key, cache, watchdog=None, ledger=None) -> None:
+              solver_opts, key, cache, watchdog=None, ledger=None,
+              policy=None, cert_rejected=None) -> None:
     """Escalation ladder for a group's failed members (mutates the result
     lists in place).
 
@@ -1399,25 +1593,38 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
     backend are excluded: their relaxation failures already re-solve on
     the exact CPU MILP in ``apply_subgroup``.  On the cpu backend with no
     fault plan the ladder short-circuits entirely — the exact solver is
-    deterministic, so re-solving cannot recover anything."""
+    deterministic, so re-solving cannot recover anything.
+
+    Every recovery is RE-CERTIFIED before it is accepted (``policy``):
+    a rung's solution that fails the float64 certificate keeps climbing
+    — retry to CPU fallback, CPU fallback to quarantine — and members in
+    ``cert_rejected`` (rejected by the initial certificate) count a
+    ``rejected_then_recovered`` when a later rung's certificate passes."""
     from ..ops.pdhg import STATUS_ITER_LIMIT, STATUS_PRIMAL_INFEASIBLE, \
         PDHGOptions
     import dataclasses
     plan = faultinject.get_plan()
+    policy = policy if policy is not None else certify.policy_from_env()
+    cert_rejected = cert_rejected if cert_rejected is not None else set()
     t0 = time.perf_counter()
     fail_idx = [i for i in fail_idx
                 if backend == "cpu" or items[i][2].integrality is None]
     if not fail_idx:
         return
     if backend == "cpu" and plan is None and \
-            not any(str(diags[i]).startswith("watchdog") for i in fail_idx):
+            not any(str(diags[i]).startswith(
+                ("watchdog", certify.REJECT_DIAG_PREFIX))
+                for i in fail_idx):
         # the exact CPU path is deterministic: re-solving the identical
         # HiGHS instance (boosted PDHG options never reach it) cannot
         # change the outcome, so a real cpu-backend failure goes straight
         # to quarantine.  A fault plan keeps the rungs reachable — the
         # injected failures it flips ARE recoverable re-solves.  Watchdog
-        # timeouts are the other exception: a hung call never produced a
-        # verdict at all, and a re-solve may complete within the deadline.
+        # timeouts are one exception: a hung call never produced a
+        # verdict at all, and a re-solve may complete within the
+        # deadline.  Certificate rejections are the other: the threat
+        # model is corrupted DATA HANDLING (a staging race, a scrambled
+        # readback), which a re-solve can absolutely recover from.
         return
     # ---- rung 1: boosted-budget retry of the failed members only ----
     retry_idx = [i for i in fail_idx
@@ -1440,6 +1647,14 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
         # private list for the same zombie-append hazard as the initial
         # rung (see resolve_group)
         retry_ledger = [] if ledger is not None else None
+        # dual-side recertification needs the retry's duals too — the
+        # rung that REJECTED for a dual/gap violation must not re-accept
+        # on a primal-only certificate (the CPU rung has no duals: the
+        # HiGHS wrapper does not surface them, so its recovery
+        # certificate is primal+objective only)
+        retry_y_box: Optional[dict] = (
+            {} if (policy.enabled and policy.check_dual
+                   and backend != "cpu") else None)
 
         def _retry_call():
             faultinject.maybe_sleep(sub_labels, faultinject.RUNG_RETRY)
@@ -1447,7 +1662,8 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
                                key=rkey, cache=cache, labels=sub_labels,
                                ledger=retry_ledger,
                                ledger_meta={"rung": "retry",
-                                            "windows": len(sub_lps)})
+                                            "windows": len(sub_lps)},
+                               y_sink=retry_y_box)
 
         (rxs, robjs, rok, rdiags, rstatuses), r_timed_out = _guarded_solve(
             watchdog, "retry", sub_lps, sub_labels, _retry_call)
@@ -1463,6 +1679,26 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
                 rstatuses[j] = STATUS_ITER_LIMIT
                 rdiags[j] = ("fault injection: forced non-convergence at "
                              "rung 'retry'")
+            if rok[j] and plan is not None:
+                bad = faultinject.maybe_corrupt(label, rxs[j],
+                                                faultinject.RUNG_RETRY, plan)
+                if bad is not None:
+                    rxs[j] = bad
+            if rok[j] and policy.enabled:
+                # the retry's solution must itself pass the float64
+                # certificate before it is accepted
+                rys = retry_y_box.get("y") if retry_y_box else None
+                if rys is not None and np.ndim(rys) == 1:
+                    rys = rys[None]
+                cert = _certify_and_record(
+                    items[i][0], label, items[i][2], rxs[j], robjs[j],
+                    policy, y=(rys[j] if rys is not None else None),
+                    was_rejected=(i in cert_rejected))
+                if not cert.accepted:
+                    rok[j] = False
+                    cert_rejected.add(i)
+                    rdiags[j] = (f"{certify.REJECT_DIAG_PREFIX} retry "
+                                 f"solution rejected: {cert.reason}")
             if rok[j]:
                 xs[i], objs[i], ok[i] = rxs[j], robjs[j], True
                 diags[i], statuses[i] = rdiags[j], rstatuses[j]
@@ -1503,7 +1739,22 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
                             f"the {watchdog.deadline_s:g}s deadline")
                 continue
         if res.status == 0 and np.isfinite(res.obj):
-            xs[i], objs[i], ok[i] = res.x, res.obj, True
+            xr = np.array(res.x, dtype=float)
+            if plan is not None:
+                bad = faultinject.maybe_corrupt(ctx.label, xr,
+                                                faultinject.RUNG_CPU, plan)
+                if bad is not None:
+                    xr = bad
+            cert = (_certify_and_record(s, ctx.label, lp, xr, res.obj,
+                                        policy,
+                                        was_rejected=(i in cert_rejected))
+                    if policy.enabled else None)
+            if cert is not None and not cert.accepted:
+                cert_rejected.add(i)
+                diags[i] = (f"{certify.REJECT_DIAG_PREFIX} CPU-fallback "
+                            f"solution rejected: {cert.reason}")
+                continue
+            xs[i], objs[i], ok[i] = xr, res.obj, True
             with _health_lock:
                 s.health["cpu_fallback"] += 1
             TellUser.info(f"window {ctx.label} rescued on the exact CPU "
@@ -1755,6 +2006,33 @@ def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
     for s in scenarios:
         for key, ctx in s.pending_window_groups():
             groups.setdefault(key, []).append((s, ctx))
+
+    # deterministic shadow-solve sample: the K pending windows (across
+    # phases and cases) with the smallest cryptographic shadow ranks
+    # re-solve on exact CPU HiGHS for an objective drift statistic —
+    # identical selection run over run, so the drift is comparable
+    cert_policy = certify.policy_from_env()
+    shadow_expected = 0
+    if cert_policy.enabled and cert_policy.shadow_k > 0 and backend != "cpu":
+        shadow_pairs = []
+        for s in scenarios:
+            # binary cases are excluded at PICK time: their accepted
+            # value on an accelerated backend is the LP relaxation, and
+            # a deterministic rank landing on one would silently zero
+            # the shadow coverage every run for that input set
+            if s.quarantine is not None or not s.opt_engine \
+                    or s.incl_binary:
+                continue
+            for ctx in getattr(s, "_pending", ()):
+                if ctx.label not in s._solved:
+                    shadow_pairs.append((s, ctx.label))
+        chosen = set(certify.pick_shadow_sample(
+            [(s.case.case_id, lbl) for s, lbl in shadow_pairs],
+            cert_policy.shadow_k))
+        shadow_expected = len(chosen)
+        for s, lbl in shadow_pairs:
+            if (s.case.case_id, lbl) in chosen:
+                s._shadow_labels.add(lbl)
     if len(scenarios) > 1 and any(len(g) > 1 for g in groups.values()):
         TellUser.info(
             f"cross-case batching: {sum(len(g) for g in groups.values())} "
@@ -1953,6 +2231,21 @@ def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
 
     ledger = summarize_solve_ledger(ledger_entries, phase_acc["solve_s"],
                                     pipeline_on, max_inflight)
+    # numerical-trust line items ride the ledger too: per-run certificate
+    # counts + certification/shadow wall time next to the device-traffic
+    # decomposition they taxed
+    ledger["certification"] = certify.aggregate_certification(
+        {i: getattr(s, "certification", None)
+         for i, s in enumerate(scenarios)})
+    shadow_got = ledger["certification"]["shadow"]["n"]
+    if shadow_got < shadow_expected:
+        # a sampled window ended quarantined (or its shadow re-solve
+        # failed): say so rather than silently shipping a run with less
+        # drift coverage than the policy promises
+        TellUser.warning(
+            f"shadow-solve coverage {shadow_got}/{shadow_expected}: "
+            "sampled window(s) were lost to quarantine or shadow-solve "
+            "failure this run")
     for s in scenarios:
         # observable for the solver cache: a degradation year must show
         # builds == distinct structures (typically 3 month lengths), not

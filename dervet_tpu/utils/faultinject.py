@@ -44,6 +44,16 @@ Two activation paths:
                                          RunSupervisor to be installed,
                                          or the default disposition kills
                                          the process)
+      DERVET_TPU_FAULT_CORRUPT=1         deterministically perturb window
+                                         1's RETURNED solution vector at
+                                         the configured rungs (scale
+                                         DERVET_TPU_FAULT_CORRUPT_SCALE,
+                                         default 0.05) — exercises the
+                                         float64 certification layer:
+                                         the solver reports success, the
+                                         numbers are wrong, and only the
+                                         independent certifier can catch
+                                         it ('all' matches every window)
 
 Faults are observational flips, input corruptions, delays, and signals
 only — the injector never touches solver internals, so the production
@@ -68,6 +78,7 @@ EVENT_POISON = "poison"    # input poisoning of a case
 EVENT_HANG = "hang"        # solve call put to sleep past the watchdog
 EVENT_SLOW = "slow_solve"  # solve call delayed (bounded)
 EVENT_PREEMPT = "preempt"  # self-delivered SIGTERM at a batch boundary
+EVENT_CORRUPT = "corrupt_solution"  # solution vector perturbed post-solve
 
 
 def _norm(values) -> frozenset:
@@ -94,7 +105,8 @@ class FaultPlan:
                  poison_cases: Iterable = (), cpu_fail: Iterable = (),
                  hang: Iterable = (), hang_seconds: float = 60.0,
                  slow: Iterable = (), slow_seconds: float = 1.0,
-                 preempt_after: Optional[int] = None):
+                 preempt_after: Optional[int] = None,
+                 corrupt: Iterable = (), corrupt_scale: float = 0.05):
         self.nonconverge = _norm(nonconverge)
         self.rungs = _norm(rungs)
         self.poison_cases = _norm(poison_cases)
@@ -108,6 +120,10 @@ class FaultPlan:
         # preempt: SIGTERM self-delivery after N window-batch boundaries
         self.preempt_after = (None if preempt_after is None
                               else int(preempt_after))
+        # corrupt_solution: perturb a RETURNED solution vector (targets
+        # window labels, honors ``rungs`` like nonconverge)
+        self.corrupt = _norm(corrupt)
+        self.corrupt_scale = float(corrupt_scale)
         self._preempt_fired = False
         self.fired: List[Tuple[str, str]] = []   # (rung/event, label/case)
 
@@ -148,6 +164,13 @@ class FaultPlan:
                 return secs, kind
         return 0.0, ""
 
+    def corrupt_due(self, label, rung: str) -> bool:
+        """Should window ``label``'s solution be perturbed at ``rung``?"""
+        if rung in self.rungs and _match(self.corrupt, label):
+            self.fired.append((EVENT_CORRUPT, str(label)))
+            return True
+        return False
+
     def preempt_due(self, batches_done: int) -> bool:
         if self.preempt_after is None or self._preempt_fired or \
                 batches_done < self.preempt_after:
@@ -168,7 +191,8 @@ _ENV_VARS = ("DERVET_TPU_FAULT_NONCONVERGE", "DERVET_TPU_FAULT_POISON_CASE",
              "DERVET_TPU_FAULT_CPU_FAIL", "DERVET_TPU_FAULT_RUNGS",
              "DERVET_TPU_FAULT_HANG", "DERVET_TPU_FAULT_HANG_S",
              "DERVET_TPU_FAULT_SLOW", "DERVET_TPU_FAULT_SLOW_S",
-             "DERVET_TPU_FAULT_PREEMPT_AFTER")
+             "DERVET_TPU_FAULT_PREEMPT_AFTER", "DERVET_TPU_FAULT_CORRUPT",
+             "DERVET_TPU_FAULT_CORRUPT_SCALE")
 _ENV_PLAN: Optional[FaultPlan] = None
 _ENV_SNAPSHOT: Optional[tuple] = None
 
@@ -180,7 +204,8 @@ def _plan_from_env() -> Optional[FaultPlan]:
     hg = os.environ.get("DERVET_TPU_FAULT_HANG")
     sl = os.environ.get("DERVET_TPU_FAULT_SLOW")
     pa = os.environ.get("DERVET_TPU_FAULT_PREEMPT_AFTER")
-    if not (nc or pc or cf or hg or sl or pa):
+    cr = os.environ.get("DERVET_TPU_FAULT_CORRUPT")
+    if not (nc or pc or cf or hg or sl or pa or cr):
         return None
     rungs = os.environ.get("DERVET_TPU_FAULT_RUNGS", RUNG_SOLVE)
     return FaultPlan(
@@ -190,7 +215,10 @@ def _plan_from_env() -> Optional[FaultPlan]:
         hang_seconds=float(os.environ.get("DERVET_TPU_FAULT_HANG_S", 60)),
         slow=sl or (),
         slow_seconds=float(os.environ.get("DERVET_TPU_FAULT_SLOW_S", 1)),
-        preempt_after=int(pa) if pa else None)
+        preempt_after=int(pa) if pa else None,
+        corrupt=cr or (),
+        corrupt_scale=float(
+            os.environ.get("DERVET_TPU_FAULT_CORRUPT_SCALE", 0.05)))
 
 
 def get_plan() -> Optional[FaultPlan]:
@@ -246,6 +274,44 @@ def maybe_sleep(labels, rung: str) -> float:
     if secs > 0:
         time.sleep(secs)
     return secs
+
+
+def corrupt_array(x: np.ndarray, label, scale: float = 0.05) -> np.ndarray:
+    """Deterministically perturb a solution vector:
+    ``x += scale * (1 + |x|) * r`` with ``r ~ U[-1, 1]`` seeded by a
+    cryptographic digest of the window label — the same label always
+    produces the same corruption, so a caught-and-escalated drill is
+    reproducible bit for bit.  The additive ``(1 + |x|)`` form perturbs
+    zero entries too (bound violations) while staying scale-free on the
+    active ones (balance-row violations + objective disagreement) — all
+    three certificate row classes light up.  Mutates in place when the
+    array is writable; device-fetched result arrays are read-only, so
+    the (possibly copied) corrupted array is RETURNED and callers must
+    use the return value."""
+    import hashlib
+
+    x = np.asarray(x)
+    if not x.flags.writeable:
+        x = x.copy()
+    seed = int.from_bytes(
+        hashlib.sha256(f"corrupt|{label}".encode()).digest()[:8], "big")
+    r = np.random.default_rng(seed).uniform(-1.0, 1.0, size=x.shape)
+    x += scale * (1.0 + np.abs(x)) * r
+    return x
+
+
+def maybe_corrupt(label, x, rung: str,
+                  plan: Optional[FaultPlan] = None) -> Optional[np.ndarray]:
+    """``corrupt_solution`` injection point: perturb window ``label``'s
+    accepted solution vector when targeted at ``rung``, returning the
+    corrupted array (None when untargeted — the fast path).  The
+    solver's own verdict (converged, residuals, objective) is left
+    untouched — exactly the silent-wrong-answer shape the float64
+    certification layer exists to catch."""
+    plan = plan if plan is not None else get_plan()
+    if plan is None or not plan.corrupt_due(label, rung):
+        return None
+    return corrupt_array(x, label, plan.corrupt_scale)
 
 
 def maybe_preempt(batches_done: int) -> bool:

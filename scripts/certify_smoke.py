@@ -1,0 +1,116 @@
+"""CI smoke: the numerical trust layer's end-to-end recovery drill.
+
+Runs a small synthetic sensitivity fan-out through the REAL batched
+dispatch (``run_dispatch(backend="jax")`` on a CPU XLA device — no chip
+required) with the ``corrupt_solution`` fault active: one window's
+returned solution vector is deterministically perturbed AFTER the solver
+declared success.  The drill then asserts the full trust loop closed:
+
+* the float64 certifier REJECTED the corrupted window (``rejected`` > 0)
+* the escalation ladder recovered it (``rejected_then_recovered`` > 0,
+  no quarantined case)
+* the final run reports 100% certified windows
+  (``windows_certified`` == windows dispatched)
+* the ``certification`` section of the run-health report is
+  schema-valid, and the invariant audit over the assembled results
+  passes
+
+A zero exit code means every assertion held — so CI proves the
+silent-wrong-answer class is caught, escalated, and recovered, not just
+that the code imports.
+
+Env knobs: SMOKE_CASES (default 3), SMOKE_MONTHS (default 2),
+SMOKE_CORRUPT_WINDOW (default 1).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    n_cases = int(os.environ.get("SMOKE_CASES", "3"))
+    months = int(os.environ.get("SMOKE_MONTHS", "2"))
+    target = os.environ.get("SMOKE_CORRUPT_WINDOW", "1")
+    os.environ["DERVET_TPU_FAULT_CORRUPT"] = target
+    os.environ.setdefault("DERVET_TPU_FAULT_CORRUPT_SCALE", "0.05")
+
+    from dervet_tpu.benchlib import synthetic_sensitivity_cases
+    from dervet_tpu.io.summary import run_health_report
+    from dervet_tpu.ops.certify import (aggregate_audits, audit_case,
+                                        validate_certification)
+    from dervet_tpu.scenario.scenario import (MicrogridScenario,
+                                              run_dispatch)
+    from dervet_tpu.utils import faultinject
+
+    scens = [MicrogridScenario(c)
+             for c in synthetic_sensitivity_cases(n_cases, months=months)]
+    run_dispatch(scens, backend="jax")     # must not raise
+
+    plan = faultinject.get_plan()
+    fired = [f for f in (plan.fired if plan else ())
+             if f[0] == faultinject.EVENT_CORRUPT]
+    if not fired:
+        raise AssertionError(
+            f"corrupt_solution fault never fired (target window {target})")
+
+    report = run_health_report(
+        {i: s.health for i, s in enumerate(scens)},
+        {i: s.quarantine for i, s in enumerate(scens)
+         if s.quarantine is not None},
+        certification_by_case={i: s.certification
+                               for i, s in enumerate(scens)})
+    cert = validate_certification(report["certification"])
+
+    quarantined = [s.case.case_id for s in scens if s.quarantine is not None]
+    if quarantined:
+        raise AssertionError(
+            f"case(s) {quarantined} quarantined — the ladder failed to "
+            "recover the corrupted window")
+    if cert["windows"]["rejected"] < 1:
+        raise AssertionError(
+            "no certificate rejection recorded — the corruption sailed "
+            "through the float64 certifier")
+    if cert["windows"]["rejected_then_recovered"] < 1:
+        raise AssertionError(
+            "rejection was not recovered through the escalation ladder")
+    if cert["windows"]["rejected_final"] != 0:
+        raise AssertionError(
+            f"{cert['windows']['rejected_final']} window(s) ended "
+            "rejected — recovery incomplete")
+    dispatched = sum(len(s.windows) for s in scens)
+    if cert["windows_certified"] != dispatched:
+        raise AssertionError(
+            f"{cert['windows_certified']}/{dispatched} windows certified "
+            "— every dispatched window must carry an accepted certificate")
+
+    audit = aggregate_audits(
+        {i: audit_case(s) for i, s in enumerate(scens)})
+    if not audit["ok"]:
+        raise AssertionError(
+            f"invariant audit failed: {json.dumps(audit['failing'])}")
+
+    print(json.dumps({
+        "smoke": "certification", "ok": True, "cases": n_cases,
+        "windows_certified": cert["windows_certified"],
+        "rejected": cert["windows"]["rejected"],
+        "rejected_then_recovered":
+            cert["windows"]["rejected_then_recovered"],
+        "cert_s": cert["cert_s"],
+        "shadow": {k: cert["shadow"][k]
+                   for k in ("n", "rel_diff_max", "shadow_s")},
+        "corrupt_events": len(fired),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,331 @@
+"""Numerical trust layer: independent float64 solution certification,
+the corrupt_solution fault drill, deterministic shadow-solve sampling,
+and the physical-invariant audit.
+
+The certifier (``ops/certify.py``) re-derives every accepted window
+solution's quality from the UNSCALED float64 LP data — independently of
+the solver's own (scaled, float32) residual bookkeeping — and rejected
+windows re-enter the PR-1 escalation ladder instead of shipping.  The
+``corrupt_solution`` fault perturbs a returned solution AFTER the solver
+declares success: the exact silent-wrong-answer shape only this layer
+can catch."""
+import json
+
+import numpy as np
+import pytest
+
+from dervet_tpu.benchlib import synthetic_case
+from dervet_tpu.ops import certify, cpu_ref
+from dervet_tpu.ops.lp import LPBuilder
+from dervet_tpu.scenario.scenario import MicrogridScenario, run_dispatch
+from dervet_tpu.utils import faultinject
+
+
+def _tiny_lp():
+    """min x0 + 2 x1  s.t.  x0 + x1 == 4,  x0 >= 1,  0 <= x <= 10.
+    Optimum x = (4, 0), obj = 4; optimal duals y = (1, 0)."""
+    b = LPBuilder()
+    x = b.var("x", 2, lb=0.0, ub=10.0)
+    b.add_cost(x, [1.0, 2.0])
+    b.add_rows("balance_row", [(x, np.array([[1.0, 1.0]]))], "eq", 4.0)
+    b.add_rows("req_row", [(x, np.array([[1.0, 0.0]]))], "ge", 1.0)
+    return b.build()
+
+
+def _small_case(case_id: int = 0, days: int = 2):
+    """Two days of the synthetic Battery+PV+DA case in 12-hour windows
+    (4 small window-LPs) — the same drill shape as test_resilience."""
+    case = synthetic_case()
+    case.case_id = case_id
+    case.scenario["allow_partial_year"] = True
+    case.scenario["n"] = 12
+    case.datasets.time_series = case.datasets.time_series.iloc[: 24 * days]
+    return case
+
+
+class TestCertifySolution:
+    def test_accepts_exact_cpu_solution(self):
+        lp = _tiny_lp()
+        res = cpu_ref.solve_lp_cpu(lp)
+        cert = certify.certify_solution(lp, res.x, res.obj)
+        assert cert.verdict == "certified"
+        assert cert.accepted
+        assert max(cert.rel_viol.values()) < 1e-6
+        assert cert.obj_rel_err < 1e-9
+
+    def test_rejects_perturbed_solution(self):
+        lp = _tiny_lp()
+        res = cpu_ref.solve_lp_cpu(lp)
+        bad = faultinject.corrupt_array(res.x.copy(), label=7, scale=0.25)
+        cert = certify.certify_solution(lp, bad, res.obj)
+        assert cert.verdict == "rejected"
+        assert not cert.accepted
+        assert cert.reason
+
+    def test_balance_class_and_worst_group(self):
+        lp = _tiny_lp()
+        cert = certify.certify_solution(lp, np.array([3.0, 0.0]), 3.0)
+        assert cert.verdict == "rejected"
+        assert cert.worst_class == "balance"
+        assert cert.worst_group == "balance_row"
+
+    def test_requirement_class(self):
+        # x0 + x1 == 4 holds, x0 >= 1 violated by 0.5
+        lp = _tiny_lp()
+        cert = certify.certify_solution(lp, np.array([0.5, 3.5]), 7.5)
+        assert cert.verdict == "rejected"
+        assert cert.worst_class == "requirement"
+        assert cert.worst_group == "req_row"
+        assert cert.abs_viol["requirement"] == pytest.approx(0.5)
+
+    def test_bounds_class(self):
+        # balance + requirement hold (5 - 1 = 4, 5 >= 1) but x1 < 0
+        lp = _tiny_lp()
+        cert = certify.certify_solution(lp, np.array([5.0, -1.0]), 3.0)
+        assert cert.verdict == "rejected"
+        assert cert.worst_class == "bounds"
+
+    def test_objective_disagreement_alone_rejects(self):
+        lp = _tiny_lp()
+        res = cpu_ref.solve_lp_cpu(lp)
+        cert = certify.certify_solution(lp, res.x, res.obj + 1.0)
+        assert cert.verdict == "rejected"
+        assert "objective" in cert.reason
+        assert max(cert.rel_viol.values()) < 1e-6  # primal was fine
+
+    def test_loose_band(self):
+        # eq violation 0.03 on row scale 9 => ~3.3e-3 rel: between
+        # eps_rel (1e-3) and the loose cut (1e-2) => certified_loose
+        lp = _tiny_lp()
+        x = np.array([4.03, 0.0])
+        cert = certify.certify_solution(lp, x, float(lp.c @ x))
+        assert cert.verdict == "certified_loose"
+        assert cert.accepted
+        assert "primal" in cert.reason
+
+    def test_dual_certificate(self):
+        lp = _tiny_lp()
+        res = cpu_ref.solve_lp_cpu(lp)
+        good = certify.certify_solution(lp, res.x, res.obj,
+                                        y=np.array([1.0, 0.0]))
+        assert good.verdict == "certified"
+        assert good.gap_rel == pytest.approx(0.0, abs=1e-9)
+        assert good.dual_rel_viol == pytest.approx(0.0, abs=1e-9)
+        bad = certify.certify_solution(lp, res.x, res.obj,
+                                       y=np.array([5.0, 0.0]))
+        assert bad.verdict == "rejected"
+        assert "gap" in bad.reason
+
+    def test_policy_env_knobs(self, monkeypatch):
+        lp = _tiny_lp()
+        x = np.array([4.0 + 1e-6, 0.0])   # ~1e-7 rel: fine by default
+        assert certify.certify_solution(lp, x, float(lp.c @ x)).accepted
+        monkeypatch.setenv("DERVET_TPU_CERT_EPS_REL", "1e-9")
+        monkeypatch.setenv("DERVET_TPU_CERT_LOOSE_FACTOR", "2")
+        policy = certify.policy_from_env()
+        assert policy.eps_rel == 1e-9
+        assert policy.loose_factor == 2
+        cert = certify.certify_solution(lp, x, float(lp.c @ x), policy)
+        assert cert.verdict == "rejected"
+
+    def test_nonfinite_solution_rejected(self):
+        lp = _tiny_lp()
+        cert = certify.certify_solution(
+            lp, np.array([np.nan, 0.0]), 4.0)
+        assert cert.verdict == "rejected"
+        assert "non-finite" in cert.reason
+
+    def test_certificate_json_serializable(self):
+        lp = _tiny_lp()
+        res = cpu_ref.solve_lp_cpu(lp)
+        cert = certify.certify_solution(lp, res.x, res.obj)
+        json.dumps(cert.as_dict())   # must not raise
+
+
+class TestCorruptSolutionFault:
+    def test_corrupt_array_deterministic(self):
+        x = np.linspace(0.0, 5.0, 16)
+        a = faultinject.corrupt_array(x.copy(), label=3)
+        b = faultinject.corrupt_array(x.copy(), label=3)
+        c = faultinject.corrupt_array(x.copy(), label=4)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+        assert not np.array_equal(a, x)
+
+    def test_env_knobs_parse(self, monkeypatch):
+        monkeypatch.setenv("DERVET_TPU_FAULT_CORRUPT", "3")
+        monkeypatch.setenv("DERVET_TPU_FAULT_CORRUPT_SCALE", "0.1")
+        plan = faultinject.get_plan()
+        assert plan is not None
+        assert plan.corrupt_scale == 0.1
+        assert plan.corrupt_due(3, "solve")
+        assert not plan.corrupt_due(4, "solve")
+        assert not plan.corrupt_due(3, "retry")    # rungs default: solve
+        assert plan.fired == [("corrupt_solution", "3")]
+
+    def test_corrupt_rejected_escalated_recovered_cpu(self):
+        """Acceptance drill (cpu backend): the corrupted window is
+        rejected by the float64 certifier, escalated down the existing
+        ladder, recovered on the boosted retry, re-certified — and the
+        final objectives match an uninjected run exactly."""
+        ref = MicrogridScenario(_small_case())
+        ref.optimize_problem_loop(backend="cpu")
+        with faultinject.inject(corrupt={1}) as plan:
+            s = MicrogridScenario(_small_case())
+            s.optimize_problem_loop(backend="cpu")
+        assert ("corrupt_solution", "1") in plan.fired
+        assert s.quarantine is None
+        cert = s.certification
+        assert cert["rejected"] == 1
+        assert cert["rejected_then_recovered"] == 1
+        assert cert["rejected_final"] == 0
+        assert cert["certified"] + cert["certified_loose"] == len(s.windows)
+        assert "1" in cert["windows"]          # rejected-window record
+        assert s.health["retried"] == 1
+        assert s.health["clean"] == len(s.windows) - 1
+        for k in ref.objective_values:
+            assert s.objective_values[k]["Total Objective"] == \
+                pytest.approx(ref.objective_values[k]["Total Objective"],
+                              rel=1e-9)
+
+    def test_corrupt_rejected_recovered_jax(self):
+        """Same drill through the batched PDHG path: only the corrupted
+        member re-solves, and every window ends certified."""
+        with faultinject.inject(corrupt={2}) as plan:
+            s = MicrogridScenario(_small_case())
+            s.optimize_problem_loop(backend="jax")
+        assert ("corrupt_solution", "2") in plan.fired
+        assert s.quarantine is None
+        cert = s.certification
+        assert cert["rejected"] == 1
+        assert cert["rejected_then_recovered"] == 1
+        assert cert["certified"] + cert["certified_loose"] == len(s.windows)
+        assert s.health["retried"] == 1
+
+    def test_corrupt_at_retry_falls_to_cpu_fallback(self):
+        """Corruption at BOTH the solve and retry rungs: the retry's
+        solution is re-certified, rejected again, and the window lands on
+        the exact CPU fallback — rungs climbed in order, recovery still
+        counted."""
+        with faultinject.inject(corrupt={1},
+                                rungs={"solve", "retry"}) as plan:
+            s = MicrogridScenario(_small_case())
+            s.optimize_problem_loop(backend="cpu")
+        fired = [f for f in plan.fired if f[0] == "corrupt_solution"]
+        assert fired == [("corrupt_solution", "1")] * 2
+        assert s.quarantine is None
+        cert = s.certification
+        assert cert["rejected"] == 2               # solve + retry rejections
+        assert cert["rejected_then_recovered"] == 1
+        assert s.health["cpu_fallback"] == 1
+        assert s.health["retried"] == 0            # disjoint final buckets
+
+    def test_certifier_disabled_lets_corruption_through(self, monkeypatch):
+        """DERVET_TPU_CERT=0 is the kill switch: with the certifier off,
+        the corrupted solution ships (proving the certifier — not some
+        other guard — is what catches it when on)."""
+        monkeypatch.setenv("DERVET_TPU_CERT", "0")
+        assert not certify.policy_from_env().enabled
+        with faultinject.inject(corrupt={1}) as plan:
+            s = MicrogridScenario(_small_case())
+            s.optimize_problem_loop(backend="cpu")
+        assert ("corrupt_solution", "1") in plan.fired
+        cert = s.certification
+        assert cert["rejected"] == 0
+        assert cert["certified"] + cert["certified_loose"] == 0
+        assert s.health["retried"] == 0        # nothing caught, no ladder
+
+
+class TestShadowSolve:
+    def test_sample_deterministic_across_runs(self, monkeypatch):
+        monkeypatch.setenv("DERVET_TPU_CERT_SHADOW_K", "2")
+        picked = []
+        for _ in range(2):
+            s = MicrogridScenario(_small_case())
+            run_dispatch([s], backend="jax")
+            sh = s.certification["shadow"]
+            assert sh["n"] == 2
+            assert sh["rel_diff_max"] < 1e-3   # PDHG vs HiGHS drift
+            assert sh["shadow_s"] > 0
+            picked.append(tuple(sorted(sh["windows"])))
+        assert picked[0] == picked[1]
+
+    def test_shadow_skipped_on_cpu_backend(self, monkeypatch):
+        monkeypatch.setenv("DERVET_TPU_CERT_SHADOW_K", "2")
+        s = MicrogridScenario(_small_case())
+        run_dispatch([s], backend="cpu")
+        assert s.certification["shadow"]["n"] == 0
+
+    def test_pick_shadow_sample_ranks(self):
+        pairs = [(0, lbl) for lbl in range(20)]
+        a = certify.pick_shadow_sample(pairs, 3)
+        b = certify.pick_shadow_sample(list(reversed(pairs)), 3)
+        assert a == b       # order-independent, rank-determined
+        assert len(a) == 3
+
+
+class TestRunHealthSection:
+    def test_certification_section_schema(self):
+        from dervet_tpu.io.summary import (log_health_report,
+                                           run_health_report)
+        s = MicrogridScenario(_small_case())
+        run_dispatch([s], backend="cpu")
+        rep = run_health_report({0: s.health}, {},
+                                certification_by_case={0: s.certification})
+        cert = certify.validate_certification(rep["certification"])
+        assert cert["windows_certified"] == len(s.windows)
+        assert cert["windows"]["rejected"] == 0
+        log_health_report(rep)     # must not raise
+        json.dumps(rep)            # persisted form is JSON
+
+    def test_ledger_carries_certification(self):
+        s = MicrogridScenario(_small_case())
+        run_dispatch([s], backend="jax")
+        ledger = s.solve_metadata["solve_ledger"]
+        cert = certify.validate_certification(ledger["certification"])
+        assert cert["cert_s"] >= 0
+        assert cert["windows_certified"] == len(s.windows)
+        assert s.solve_metadata["certification"]["certified"] \
+            + s.solve_metadata["certification"]["certified_loose"] \
+            == len(s.windows)
+
+
+class TestInvariantAudit:
+    def test_clean_run_passes(self):
+        s = MicrogridScenario(_small_case())
+        s.optimize_problem_loop(backend="cpu")
+        audit = certify.audit_case(s)
+        assert audit["ok"], audit
+        checks = audit["checks"]
+        assert checks["soe_recurrence"]["transitions"] > 0
+        assert checks["soe_recurrence"]["rel_max"] < 1e-6   # exact CPU
+        assert checks["soe_seams"]["rel_max"] < 1e-6
+        assert checks["objective_components"]["rel_max"] < 1e-9
+
+    def test_scrambled_scatter_caught(self):
+        """A post-solve corruption of the assembled solution arrays —
+        the window-mixup / scatter-race shape — trips the SOE recurrence
+        even though every per-window certificate passed."""
+        s = MicrogridScenario(_small_case())
+        s.optimize_problem_loop(backend="cpu")
+        ene = s._solution["Battery-1/ene"]
+        ene[5:15] = ene[5:15][::-1].copy()     # scramble a stretch
+        audit = certify.audit_case(s)
+        assert not audit["ok"]
+        assert not audit["checks"]["soe_recurrence"]["ok"]
+
+    def test_bound_violation_caught(self):
+        s = MicrogridScenario(_small_case())
+        s.optimize_problem_loop(backend="cpu")
+        bat = next(d for d in s.ders if d.tag == "Battery")
+        s._solution["Battery-1/dis"][3] = bat.discharge_capacity() * 1.5
+        audit = certify.audit_case(s)
+        assert not audit["checks"]["dispatch_bounds"]["ok"]
+
+    def test_aggregate_audits(self):
+        good = {"ok": True, "checks": {}}
+        bad = {"ok": False, "checks": {"soe_seams": {"ok": False}}}
+        agg = certify.aggregate_audits({0: good, 1: bad, 2: None})
+        assert not agg["ok"]
+        assert agg["cases_audited"] == 2
+        assert list(agg["failing"]) == ["1"]

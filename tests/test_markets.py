@@ -115,3 +115,43 @@ def test_ts_bid_bounds():
         cap = grab_column(raw, max_col)
         assert cap is not None
         assert (ts[award_col].to_numpy() <= cap + 1e-4).all(), award_col
+
+
+def test_component_sum_equals_total_with_tilt():
+    """Regression (ADVICE r5 medium, closed by the numerical trust PR):
+    the tiebreak tilt used to ride as an UNLABELED cost, so the labeled
+    per-stream revenue components summed to the tilted total minus an
+    invisible residual.  Now the tilt is its own explicit objective
+    column and "Total Objective" subtracts it — labeled components must
+    sum to the reported total to 1e-9.  Synthetic FR+SR market case: no
+    reference data needed."""
+    import numpy as np
+    from dervet_tpu.benchlib import synthetic_case
+    from dervet_tpu.models.streams.markets import TILT_LABEL
+    from dervet_tpu.scenario.scenario import MicrogridScenario
+
+    case = synthetic_case()
+    case.scenario["allow_partial_year"] = True
+    case.scenario["n"] = 12
+    ts = case.datasets.time_series.iloc[:48].copy()
+    rng = np.random.default_rng(0)
+    ts["Reg Up Price ($/kW)"] = 0.010 + 0.005 * rng.random(len(ts))
+    ts["Reg Down Price ($/kW)"] = 0.008 + 0.004 * rng.random(len(ts))
+    ts["SR Price ($/kW)"] = 0.006 + 0.003 * rng.random(len(ts))
+    case.datasets.time_series = ts
+    case.streams["FR"] = {"duration": 0.25, "eou": 0.3, "eod": 0.3,
+                          "growth": 0}
+    case.streams["SR"] = {"duration": 0.25, "growth": 0}
+    s = MicrogridScenario(case)
+    s.optimize_problem_loop(backend="cpu")
+    assert s.quarantine is None
+    saw_tilt = False
+    for label, bd in s.objective_values.items():
+        total = bd["Total Objective"]
+        comp = sum(v for k, v in bd.items()
+                   if k not in ("Total Objective", TILT_LABEL))
+        assert comp == pytest.approx(total, rel=1e-9, abs=1e-9), label
+        saw_tilt = saw_tilt or abs(bd.get(TILT_LABEL, 0.0)) > 0
+    # the tilt term must be REPORTED (nonzero with market awards), not
+    # silently folded away
+    assert saw_tilt
