@@ -218,6 +218,11 @@ def main() -> None:
             legs["long_horizon_5min_year"] = long_horizon_leg()
         except Exception as e:          # noqa: BLE001
             legs["long_horizon_5min_year"] = {"error": str(e)[:300]}
+    if int(os.environ.get("BENCH_SERVING", "1")):
+        try:
+            legs["serving"] = serving_leg()
+        except Exception as e:          # noqa: BLE001
+            legs["serving"] = {"error": str(e)[:300]}
     config["legs"] = legs
 
     # scale the target linearly if running fewer scenarios than the baseline
@@ -503,6 +508,113 @@ def long_horizon_leg() -> dict:
             "iters": int(res.iters),
             "utilization": util,
             "obj_rel_err": float(f"{rel:.3e}")}
+
+
+def serving_leg() -> dict:
+    """Scenario-service proof: a fixed offered load of mixed-size
+    requests against a WARM service, vs the cold one-shot ``DERVET.
+    solve`` every caller pays today.
+
+    Measured (published under ``legs.serving``): warm single-case
+    request latency vs cold solve latency (the acceptance gate: warm
+    must win — the service amortizes device warm-up + XLA compiles that
+    dominate a cold 1-case run), offered-load latency p50/p99,
+    steady-state throughput, batch occupancy (windows per device batch —
+    small requests riding coalesced batches), and the compile-cache hit
+    rate with the load phase's compile-event count (a hot service's
+    steady state is zero).
+
+    The cold number is an IN-PROCESS cold one-shot: fresh solvers, fresh
+    compiles — but when this leg runs inside a full ``bench.py`` pass
+    the earlier legs have already paid JAX platform init, so it
+    understates a truly cold caller.  Run the leg standalone
+    (``python -c 'import bench; bench.serving_leg()'``) for a
+    cold-process baseline; the PERF.md numbers were measured that
+    way."""
+    import numpy as _np
+
+    from dervet_tpu.api import DERVET
+    from dervet_tpu.benchlib import synthetic_sensitivity_cases
+    from dervet_tpu.service import ScenarioService
+
+    months = int(os.environ.get("BENCH_SERVE_MONTHS", "2"))
+    n_load = int(os.environ.get("BENCH_SERVE_REQUESTS", "9"))
+
+    def request_cases(n):
+        return {i: c for i, c in
+                enumerate(synthetic_sensitivity_cases(n, months=months))}
+
+    # cold baseline: fresh one-shot solve of ONE case (device init + XLA
+    # compiles + full sweep machinery, nothing amortized)
+    t0 = time.time()
+    DERVET.from_cases(request_cases(1)).solve(backend="jax")
+    t_cold = time.time() - t0
+
+    svc = ScenarioService(backend="jax", max_wait_s=0.05)
+    svc.start()
+    try:
+        t0 = time.time()
+        svc.submit(request_cases(1), request_id="warmup").result()
+        t_first = time.time() - t0      # the service's own cold start
+        warm_lat = []
+        for i in range(3):
+            t0 = time.time()
+            svc.submit(request_cases(1), request_id=f"warm{i}").result()
+            warm_lat.append(time.time() - t0)
+        t_warm = float(_np.median(warm_lat))
+
+        # offered load: mixed-size requests (1/2/3 cases cycling) pushed
+        # concurrently, coalescing through the continuous batcher
+        sizes = [1 + (i % 3) for i in range(n_load)]
+        compiles_before = svc.metrics()["rounds"]["compile_events"]
+        t0 = time.time()
+        futs = [svc.submit(request_cases(sz), request_id=f"load{i}")
+                for i, sz in enumerate(sizes)]
+        results = [f.result() for f in futs]
+        t_load = time.time() - t0
+        m = svc.metrics()
+    finally:
+        svc.close()
+
+    lat = sorted(r.request_latency_s for r in results)
+    p50 = float(_np.percentile(lat, 50))
+    p99 = float(_np.percentile(lat, 99))
+    total_cases = sum(sizes)
+    total_windows = sum(sl["totals"]["windows"] for sl in
+                        (r.solve_ledger for r in results) if sl)
+    load_compiles = m["rounds"]["compile_events"] - compiles_before
+    occupancy = m["batch_occupancy"]["mean_windows_per_device_batch"]
+    hit_rate = m["compile_cache"]["hit_rate"]
+    ok = t_warm < t_cold
+    log(f"bench[serving]: warm single-case {t_warm * 1e3:.0f}ms vs cold "
+        f"DERVET.solve {t_cold:.2f}s ({t_cold / t_warm:.1f}x; service "
+        f"first-request {t_first:.2f}s); offered load {n_load} requests "
+        f"({total_cases} cases, {total_windows} windows) in {t_load:.2f}s "
+        f"-> {total_cases / t_load:.2f} cases/s, latency p50/p99 "
+        f"{p50 * 1e3:.0f}/{p99 * 1e3:.0f}ms; occupancy "
+        f"{occupancy:.1f} windows/device batch, compile-cache hit rate "
+        f"{hit_rate}, load-phase compiles {load_compiles}; "
+        f"warm-beats-cold gate: {'OK' if ok else 'FAIL'}")
+    if not ok:
+        raise SystemExit(6)
+    return {
+        "requests": n_load,
+        "cases": total_cases,
+        "cold_solve_single_case_s": round(t_cold, 3),
+        "service_first_request_s": round(t_first, 3),
+        "warm_single_case_s": round(t_warm, 4),
+        "warm_vs_cold_speedup": round(t_cold / t_warm, 1),
+        "offered_load_s": round(t_load, 3),
+        "throughput_cases_per_s": round(total_cases / t_load, 2),
+        "latency_p50_s": round(p50, 4),
+        "latency_p99_s": round(p99, 4),
+        "batch_occupancy_windows": occupancy,
+        "compile_cache_hit_rate": hit_rate,
+        "load_phase_compile_events": int(load_compiles),
+        "queue": {k: m["queue"][k] for k in
+                  ("admitted", "rejected_full", "rejected_overload",
+                   "expired")},
+    }
 
 
 def real_case_leg() -> None:

@@ -1,11 +1,20 @@
 """``python -m dervet_tpu`` / ``dervet-tpu`` console entry (mirrors
-reference run_DERVET.py:73-92)."""
+reference run_DERVET.py:73-92).  ``dervet-tpu serve SPOOL_DIR`` starts
+the persistent scenario service instead (service.server.serve_main)."""
 from __future__ import annotations
 
 import argparse
 
 
 def main(argv=None):
+    import sys
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "serve":
+        # the serving loop: long-lived service, cross-request continuous
+        # batching, SIGTERM drain with exit 0 — its own argparse surface
+        from .service.server import serve_main
+        raise SystemExit(serve_main(argv[1:]))
+
     from .api import DERVET
 
     parser = argparse.ArgumentParser(

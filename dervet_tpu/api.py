@@ -78,6 +78,22 @@ class DERVET:
                       f"{model_parameters_path}")
         self.init_seconds = time.time() - self.start_time
 
+    @classmethod
+    def from_cases(cls, cases, verbose: bool = False) -> "DERVET":
+        """Build a DERVET around already-constructed :class:`CaseParams`
+        (a dict keyed by case id, or an iterable) — the file-free entry
+        the scenario service and benchmarks use, bypassing only the
+        params parsing, never the solve pipeline."""
+        self = cls.__new__(cls)
+        self.start_time = time.time()
+        self.init_seconds = 0.0
+        self.verbose = verbose
+        self.cases = (dict(cases) if isinstance(cases, dict)
+                      else dict(enumerate(cases)))
+        if not self.cases:
+            raise ValueError("from_cases needs at least one case")
+        return self
+
     # "auto" backend routing: below this many windows x cases the XLA
     # compile bill (~45-90 s per structure on a cold remote chip) cannot
     # amortize against the exact CPU solver's ~0.2 s/window, so small runs
@@ -87,12 +103,16 @@ class DERVET:
     AUTO_JAX_MIN_WINDOWS = 128
 
     def solve(self, backend: str = "auto", solver_opts=None,
-              checkpoint_dir=None):
+              checkpoint_dir=None, request_id=None):
         from .results.result import Result
         if self.verbose:
             from .io.summary import class_summary
             class_summary(self.cases)
         results = Result.initialize(self.cases)
+        # request-scoped runs (the serving layer, or any caller running
+        # concurrent solves into one output dir) namespace their run
+        # artifacts; None keeps today's single-run filenames
+        results.request_id = request_id
         # all cases dispatch through ONE driver call: windows with identical
         # constraint structure batch across the sensitivity-case axis into
         # single device calls, sharded over the accelerator mesh when more

@@ -20,6 +20,24 @@ HEALTH_KEYS = ("clean", "inaccurate", "retried", "cpu_fallback",
                "quarantined", "skipped")
 
 
+def run_artifact_name(base: str, request_id=None) -> str:
+    """Namespace a run artifact filename by request id: ``run_health.json``
+    -> ``run_health.<rid>.json`` — so concurrent service requests sharing
+    one process (or one output/checkpoint directory) cannot clobber each
+    other's reports.  With no request id the name is returned unchanged,
+    so the single-run CLI path keeps today's filenames.  The id is
+    sanitized to filename-safe characters ([A-Za-z0-9._-], the rest
+    mapped to ``_``)."""
+    if request_id in (None, ""):
+        return base
+    rid = "".join(ch if (ch.isalnum() or ch in "._-") else "_"
+                  for ch in str(request_id))
+    stem, dot, suffix = base.rpartition(".")
+    if not dot:
+        return f"{base}.{rid}"
+    return f"{stem}.{rid}.{suffix}"
+
+
 def class_summary(cases: Dict) -> None:
     first = cases[min(cases.keys())]
     sections = [("Scenario", first.scenario), ("Finance", first.finance),

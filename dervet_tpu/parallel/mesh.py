@@ -40,6 +40,23 @@ class ShardedStats(NamedTuple):
     max_prim_res: jax.Array  # worst primal residual across the mesh
 
 
+def warmup_devices() -> dict:
+    """Pay backend/device initialization up front (serving layer): the
+    first JAX touch of a process initializes the platform, allocates the
+    transfer arenas, and compiles a trivial program — tens of
+    milliseconds to seconds that would otherwise land inside the FIRST
+    request's latency.  A :class:`~dervet_tpu.service.server.
+    ScenarioService` calls this at ``start()`` so admission begins on a
+    warm device.  Returns the device inventory for the service's
+    metrics surface."""
+    devs = jax.devices()
+    x = jax.device_put(jnp.zeros(8, jnp.float32))
+    jax.jit(lambda a: a + 1.0)(x).block_until_ready()
+    return {"n_devices": len(devs),
+            "platform": devs[0].platform,
+            "device_kind": devs[0].device_kind}
+
+
 def scenario_mesh(n_devices: Optional[int] = None) -> Mesh:
     """1-D mesh over the scenario/batch axis."""
     devs = jax.devices()

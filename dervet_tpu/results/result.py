@@ -40,6 +40,15 @@ class Result:
         # per-group solve ledger (perf observability), attached by
         # api.solve from the dispatch driver's solve_metadata
         self.solve_ledger: Optional[Dict] = None
+        # serving layer: the request these results belong to — namespaces
+        # the run artifacts (run_health.<rid>.json, solve_ledger.<rid>.json)
+        # so concurrent requests sharing one process/output dir cannot
+        # clobber each other; None (the single-run CLI path) keeps
+        # today's filenames
+        self.request_id: Optional[str] = None
+        # serving layer: request wall-clock latency (submit -> result),
+        # recorded by the service batcher
+        self.request_latency_s: Optional[float] = None
 
     def build_instance(self, scenario) -> "CaseResult":
         """Build (but do not register) one case's result frames — the
@@ -69,15 +78,26 @@ class Result:
         return df
 
     def save_as_csv(self, out_dir=None) -> None:
+        from ..io.summary import run_artifact_name
         from ..utils.supervisor import atomic_output, atomic_write
         out = Path(out_dir or self.dir_abs_path)
         if self.run_health is not None:
             # persisted next to the output set so a large sweep's solver
             # degradations (retries, CPU fallbacks, quarantined cases) are
-            # auditable after the run, not just scrollback
+            # auditable after the run, not just scrollback; namespaced by
+            # request id when these results came through the service
             import json
-            atomic_write(out / "run_health.json",
+            atomic_write(out / run_artifact_name("run_health.json",
+                                                 self.request_id),
                          json.dumps(self.run_health, indent=2))
+        if self.request_id is not None and self.solve_ledger is not None:
+            # service requests persist their solve-ledger slice too (the
+            # single-run path publishes the ledger via bench/api instead,
+            # keeping today's file set unchanged)
+            import json
+            atomic_write(out / run_artifact_name("solve_ledger.json",
+                                                 self.request_id),
+                         json.dumps(self.solve_ledger, indent=2))
         for key, inst in self.instances.items():
             label = f"{self.csv_label}{key}" if len(self.instances) > 1 else self.csv_label
             inst.save_as_csv(out, label)

@@ -1,0 +1,13 @@
+"""Serving layer: persistent ScenarioService with cross-request
+continuous batching (see server.py for the architecture notes)."""
+from .client import ScenarioClient
+from .queue import (AdmissionQueue, DeadlineExpiredError, QueueFullError,
+                    RequestFailedError, RequestPreemptedError,
+                    ServiceClosedError, ServiceError)
+from .server import ScenarioService, serve_main
+
+__all__ = [
+    "AdmissionQueue", "DeadlineExpiredError", "QueueFullError",
+    "RequestFailedError", "RequestPreemptedError", "ScenarioClient",
+    "ScenarioService", "ServiceClosedError", "ServiceError", "serve_main",
+]

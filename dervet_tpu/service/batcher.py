@@ -1,0 +1,341 @@
+"""Continuous batcher: coalesce queued requests into one dispatch round.
+
+One round takes every request the admission queue handed over, builds all
+their cases' scenarios, and runs a SINGLE ``run_dispatch`` over the union
+— the existing structure-key grouping then batches windows ACROSS
+requests exactly as it batches sensitivity cases, so a 1-case request
+arriving next to a 32-case request rides the big request's device batches
+for free.  Everything downstream is the existing stack, reused rather
+than forked: solves go through the PR-3 overlapped pipeline, failures
+climb the PR-1 escalation ladder, every window is PR-4 certified, and a
+SIGTERM lands in the PR-2 supervisor's graceful-drain path with per-case
+checkpoints plus per-request manifests flushed.
+
+Per-request isolation: case ids are namespaced ``<request_id>.<key>`` so
+checkpoints/manifest entries cannot collide across requests, each request
+gets its own run-health report and solve-ledger slice, and one request's
+total failure (all cases quarantined) answers THAT request with a typed
+error while the round's other requests complete normally.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+from ..io.summary import run_health_report
+from ..ops.certify import aggregate_audits
+from ..results.result import Result
+from ..scenario.scenario import MicrogridScenario, run_dispatch
+from ..utils.errors import (AggregatedSolverError, PreemptedError,
+                            TellUser)
+from .queue import (DeadlineExpiredError, QueuedRequest,
+                    RequestFailedError, RequestPreemptedError,
+                    ServiceError)
+
+# per-request ledger slices aggregate these numeric fields over the
+# request's groups (a subset of the full ledger's totals: only what is
+# attributable to a single request — shared round-level walls stay under
+# ``round`` below)
+_SLICE_SUM_KEYS = ("solve_s", "stack_s", "h2d_s", "sync_wait_s",
+                   "result_fetch_s", "h2d_bytes", "result_bytes",
+                   "dispatches", "chunks", "compile_events")
+
+
+def slice_request_ledger(ledger: Optional[Dict], request_id: str,
+                         n_windows: Optional[int] = None
+                         ) -> Optional[Dict]:
+    """A request's view of the round's solve ledger: the per-group
+    entries whose batch carried this request's windows (tagged by
+    ``resolve_group`` via ``meta['requests']``), their summed line items,
+    and the shared round totals for context.  Escalation-rung entries
+    (retry / cpu_fallback) carry no request tag and stay round-level.
+    The summed line items cover the SHARED groups the request rode —
+    ``totals.batched_windows`` is that co-batched total, ``windows`` the
+    request's own count."""
+    if ledger is None:
+        return None
+    rid = str(request_id)
+    groups = [g for g in ledger.get("groups", ())
+              if rid in (g.get("requests") or ())]
+    totals = {k: round(sum(float(g.get(k, 0)) for g in groups), 4)
+              for k in _SLICE_SUM_KEYS}
+    totals["batched_windows"] = sum(int(g.get("batch", 0)) for g in groups
+                                    if g.get("rung") in (None, "initial"))
+    if n_windows is not None:
+        totals["windows"] = int(n_windows)
+    return {
+        "request_id": rid,
+        "groups": groups,
+        "totals": totals,
+        # groups whose batch mixed several requests: the cross-request
+        # coalescing observable (windows this request amortized against
+        # other requests' batches)
+        "coalesced_groups": sum(1 for g in groups
+                                if len(g.get("requests") or ()) > 1),
+        "round": {k: ledger.get(k) for k in
+                  ("dispatch_solve_s", "pipeline", "max_inflight")},
+        "round_totals": ledger.get("totals"),
+    }
+
+
+def build_request_result(req: QueuedRequest,
+                         scenarios: Dict[object, MicrogridScenario],
+                         ledger: Optional[Dict]) -> Result:
+    """Assemble one request's :class:`Result` from its solved scenarios —
+    the same collection path as ``api.DERVET.solve``'s tail (results
+    registry, run-health report, invariant audit, sensitivity summary),
+    scoped to the request.  Raises :class:`RequestFailedError` when every
+    case quarantined."""
+    results = Result.initialize(req.cases)
+    results.request_id = req.request_id
+    report = run_health_report(
+        {key: getattr(s, "health", {}) for key, s in scenarios.items()},
+        {key: s.quarantine for key, s in scenarios.items()
+         if s.quarantine is not None},
+        certification_by_case={key: getattr(s, "certification", None)
+                               for key, s in scenarios.items()})
+    results.run_health = report
+    if all(s.quarantine is not None for s in scenarios.values()):
+        raise RequestFailedError(
+            {key: s.quarantine["reason"] for key, s in scenarios.items()})
+    for key, s in scenarios.items():
+        if s.quarantine is not None:
+            TellUser.error(
+                f"request {req.request_id}: case {key} excluded from "
+                f"results (quarantined): {s.quarantine['reason']}")
+            continue
+        results.add_instance(key, s)
+    audit = aggregate_audits(
+        {key: getattr(inst, "invariant_audit", None)
+         for key, inst in results.instances.items()})
+    report["invariant_audit"] = audit
+    if not audit["ok"]:
+        TellUser.warning(
+            f"request {req.request_id}: invariant audit FAILED for "
+            f"case(s) {sorted(audit['failing'])}")
+    results.sensitivity_summary()
+    results.solve_ledger = slice_request_ledger(
+        ledger, req.request_id,
+        n_windows=sum(len(s.windows) for s in scenarios.values()))
+    return results
+
+
+class BatchRound:
+    """One coalesced dispatch round over a list of admitted requests.
+
+    ``on_stats(round)`` fires once per round, after the ledger/stats are
+    final but BEFORE any request future resolves — so a client that
+    wakes on ``fut.result()`` can immediately read service metrics and
+    ``last_round_ledger`` without racing the bookkeeping."""
+
+    def __init__(self, requests: List[QueuedRequest], *, backend: str,
+                 solver_opts=None, solver_cache=None, supervisor=None,
+                 checkpoint_dir=None, on_stats=None,
+                 gc_checkpoints: bool = True):
+        self.requests = requests
+        self.backend = backend
+        self.solver_opts = solver_opts
+        self.solver_cache = solver_cache
+        self.supervisor = supervisor
+        self.checkpoint_dir = checkpoint_dir
+        self.on_stats = on_stats
+        # a persistent service must not grow one checkpoint set per
+        # request served forever: a successfully DELIVERED request's
+        # npz checkpoints + manifest slice are garbage-collected (their
+        # resume value is spent); failed/preempted requests keep theirs
+        self.gc_checkpoints = bool(gc_checkpoints)
+        # per-request scenario maps, built in run(); round observables
+        self.scenarios: Dict[str, Dict[object, MicrogridScenario]] = {}
+        self.ledger: Optional[Dict] = None
+        self.stats: Dict[str, object] = {}
+        self.preempted = False
+        # requests answered during batch assembly (expired / duplicate
+        # id / assembly failure) — kept so the service's request
+        # accounting still covers them
+        self.answered_early: List[QueuedRequest] = []
+
+    # ------------------------------------------------------------------
+    def _build_scenarios(self) -> List[MicrogridScenario]:
+        """Construct every live request's scenarios (namespaced case
+        ids); a request whose assembly raises is answered with that
+        error and dropped from the round — it cannot poison the batch."""
+        all_scens: List[MicrogridScenario] = []
+        live: List[QueuedRequest] = []
+        for req in self.requests:
+            if req.expired():
+                req.future.set_exception(DeadlineExpiredError(
+                    f"request {req.request_id!r} expired before its "
+                    "batch was assembled"))
+                self.answered_early.append(req)
+                continue
+            if req.request_id in self.scenarios:
+                # same-id requests in one round would cross-wire results
+                # (scenario maps, checkpoints, manifests are all keyed by
+                # request id) — the service rejects duplicates at
+                # admission; this guards direct queue users too
+                req.future.set_exception(ServiceError(
+                    f"duplicate request id {req.request_id!r} in one "
+                    "batch round"))
+                self.answered_early.append(req)
+                continue
+            try:
+                scens: Dict[object, MicrogridScenario] = {}
+                for key, case in req.cases.items():
+                    namespaced = dataclasses.replace(
+                        case, case_id=f"{req.request_id}.{key}")
+                    s = MicrogridScenario(namespaced)
+                    s.request_id = req.request_id
+                    scens[key] = s
+            except Exception as e:      # bad inputs fail only this request
+                TellUser.error(f"request {req.request_id}: scenario "
+                               f"assembly failed: {e}")
+                req.future.set_exception(e)
+                self.answered_early.append(req)
+                continue
+            self.scenarios[req.request_id] = scens
+            all_scens.extend(scens.values())
+            live.append(req)
+        self.requests = live
+        return all_scens
+
+    def _write_one_manifest(self, req: QueuedRequest) -> None:
+        if not self.checkpoint_dir:
+            return
+        from ..utils import supervisor as _sup
+        scens = self.scenarios.get(req.request_id)
+        if scens:
+            _sup.write_manifest(self.checkpoint_dir,
+                                list(scens.values()), self.backend,
+                                request_id=req.request_id)
+
+    def _write_request_manifests(self) -> None:
+        """Flush one namespaced resume manifest per live request (the
+        drain path: preserved so resubmission resumes)."""
+        for req in self.requests:
+            self._write_one_manifest(req)
+
+    def _gc_request_artifacts(self, req: QueuedRequest) -> None:
+        """Drop a successfully delivered request's on-disk resume
+        material — its value is spent, and a hot service would otherwise
+        accumulate one checkpoint set per request forever."""
+        if not (self.checkpoint_dir and self.gc_checkpoints):
+            return
+        import contextlib
+        from ..utils.supervisor import manifest_path
+        for s in self.scenarios.get(req.request_id, {}).values():
+            with contextlib.suppress(OSError):
+                s._checkpoint_path(self.checkpoint_dir).unlink(
+                    missing_ok=True)
+        with contextlib.suppress(OSError):
+            manifest_path(self.checkpoint_dir,
+                          req.request_id).unlink(missing_ok=True)
+
+    def _emit_stats(self) -> None:
+        if self.on_stats is not None:
+            try:
+                self.on_stats(self)
+            except Exception:
+                pass    # bookkeeping must never break delivery
+
+    # ------------------------------------------------------------------
+    def run(self) -> None:
+        """Dispatch the round and deliver every request's future.
+
+        Raises :class:`~dervet_tpu.utils.errors.PreemptedError` after
+        answering the in-flight requests with
+        :class:`RequestPreemptedError` (manifests flushed) — the server
+        loop treats that as the drain signal."""
+        t0 = time.monotonic()
+        all_scens = self._build_scenarios()
+        if not all_scens:
+            self._finish_stats(all_scens, t0)
+            self._emit_stats()
+            return
+        try:
+            run_dispatch(all_scens, backend=self.backend,
+                         solver_opts=self.solver_opts,
+                         checkpoint_dir=self.checkpoint_dir,
+                         supervisor=self.supervisor,
+                         solver_cache=self.solver_cache)
+        except PreemptedError as e:
+            # run_dispatch already flushed per-case checkpoints + the
+            # shared sweep manifest; add the per-request slices, then
+            # answer every in-flight future with the typed, resumable
+            # preemption error
+            self.preempted = True
+            self._write_request_manifests()
+            self._finish_stats(all_scens, t0)
+            self._emit_stats()
+            from ..utils.supervisor import manifest_path
+            for req in self.requests:
+                if not req.future.done():
+                    req.future.set_exception(RequestPreemptedError(
+                        f"request {req.request_id!r} preempted mid-"
+                        f"dispatch ({e}); resubmit with the same request "
+                        "id and checkpoint directory to resume",
+                        manifest_path=(manifest_path(self.checkpoint_dir,
+                                                     req.request_id)
+                                       if self.checkpoint_dir else None)))
+            raise
+        except AggregatedSolverError:
+            # every case of every request quarantined: answer each
+            # request with ITS slice of the diagnoses; the service stays
+            # up (the error is data-shaped, not service-shaped)
+            self.ledger = all_scens[0].solve_metadata.get("solve_ledger")
+            self._finish_stats(all_scens, t0)
+            self._emit_stats()
+            for req in self.requests:
+                self._write_one_manifest(req)   # keep resume material
+                scens = self.scenarios[req.request_id]
+                req.future.set_exception(RequestFailedError(
+                    {key: (s.quarantine or {}).get("reason")
+                     for key, s in scens.items()}))
+            return
+        except Exception as e:
+            # an unexpected dispatch error (device OOM, driver bug) must
+            # still ANSWER every in-flight future — a leaked unresolved
+            # future hangs its client forever — before propagating to
+            # the service loop for logging
+            self._finish_stats(all_scens, t0)
+            self._emit_stats()
+            for req in self.requests:
+                if not req.future.done():
+                    req.future.set_exception(e)
+            raise
+        self.ledger = all_scens[0].solve_metadata.get("solve_ledger")
+        self._finish_stats(all_scens, t0)
+        self._emit_stats()
+        for req in self.requests:
+            scens = self.scenarios[req.request_id]
+            try:
+                results = build_request_result(req, scens, self.ledger)
+                results.request_latency_s = time.monotonic() - req.t_submit
+                req.future.set_result(results)
+                self._gc_request_artifacts(req)
+            except Exception as e:      # post failure stays per-request
+                if not isinstance(e, RequestFailedError):
+                    TellUser.error(f"request {req.request_id}: result "
+                                   f"collection failed: {e}")
+                self._write_one_manifest(req)   # keep resume material
+                req.future.set_exception(e)
+
+    def _finish_stats(self, all_scens, t0) -> None:
+        led = self.ledger or {}
+        initial = [g for g in led.get("groups", ())
+                   if g.get("rung") in (None, "initial")]
+        self.stats = {
+            "round_s": time.monotonic() - t0,
+            "requests": len(self.requests),
+            "cases": len(all_scens),
+            "windows": sum(len(s.windows) for s in all_scens),
+            "device_groups": len(initial),
+            # continuous-batching occupancy: windows per device batch
+            # (the whole point — small requests riding big batches)
+            "mean_batch": (sum(g.get("batch", 0) for g in initial)
+                           / len(initial)) if initial else 0.0,
+            "cross_request_groups": sum(
+                1 for g in initial if len(g.get("requests") or ()) > 1),
+            "compile_events": int(
+                (led.get("totals") or {}).get("compile_events", 0)),
+        }

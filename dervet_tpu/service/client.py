@@ -1,0 +1,58 @@
+"""Client-side conveniences for the scenario service.
+
+The service is in-process (a network front end would wrap
+:class:`~dervet_tpu.service.server.ScenarioService` behind whatever
+transport a deployment uses); this module provides the client-side
+discipline such a front end needs anyway: retry-after handling for
+backpressure rejections and a blocking solve wrapper.
+"""
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future
+from typing import Optional
+
+from ..utils.errors import TellUser
+from .queue import QueueFullError
+
+
+class ScenarioClient:
+    """Thin client over a :class:`ScenarioService`.
+
+    ``submit`` honors the service's backpressure contract: a
+    :class:`~dervet_tpu.service.queue.QueueFullError` carries a
+    ``retry_after_s`` hint, and the client sleeps it out and retries up
+    to ``max_retries`` times before surfacing the rejection — the
+    behavior every caller of a loaded service needs and nobody should
+    hand-roll."""
+
+    def __init__(self, service, max_retries: int = 3,
+                 backoff_cap_s: float = 30.0):
+        self.service = service
+        self.max_retries = int(max_retries)
+        self.backoff_cap_s = float(backoff_cap_s)
+
+    def submit(self, cases, *, request_id=None, priority: int = 0,
+               deadline_s: Optional[float] = None) -> Future:
+        """Admit with bounded retry-after backoff on queue-full."""
+        attempt = 0
+        while True:
+            try:
+                return self.service.submit(cases, request_id=request_id,
+                                           priority=priority,
+                                           deadline_s=deadline_s)
+            except QueueFullError as e:
+                attempt += 1
+                if attempt > self.max_retries:
+                    raise
+                wait = min(e.retry_after_s, self.backoff_cap_s)
+                TellUser.info(
+                    f"client: queue full, retry {attempt}/"
+                    f"{self.max_retries} in {wait:.2f}s")
+                time.sleep(wait)
+
+    def solve(self, cases, *, timeout: Optional[float] = None,
+              **kwargs):
+        """Submit and block for the request's
+        :class:`~dervet_tpu.results.result.Result`."""
+        return self.submit(cases, **kwargs).result(timeout=timeout)

@@ -1,0 +1,216 @@
+"""Bounded admission queue for the scenario service.
+
+The serving layer's front door: submissions are admitted into a bounded
+priority queue (backpressure by REJECTION, never by unbounded buffering —
+a saturated service must shed load with a typed, retryable error instead
+of growing until the host OOMs mid-solve), ordered by priority then
+strict FIFO, each carrying an optional deadline after which the request
+is answered with a typed expiry error instead of wasting a device batch.
+
+The continuous batcher drains the queue with :meth:`AdmissionQueue.take`:
+block until at least one request is pending, then hold the batch open for
+``max_wait_s`` (or until ``max_batch`` requests) so small requests
+arriving close together coalesce into one device dispatch — the
+cross-request continuous-batching discipline MPAX-style batched LP
+solving assumes (PAPERS.md: arxiv 2412.09734) but never provides a
+serving harness for.
+
+The ``overload`` fault kind (``DERVET_TPU_FAULT_OVERLOAD[_N]``) forces
+admissions down the queue-full rejection path deterministically, so
+backpressure and client retry-after handling are drillable like every
+other failure mode.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional
+
+from ..utils import faultinject
+
+
+class ServiceError(Exception):
+    """Base of the scenario service's typed errors."""
+
+
+class QueueFullError(ServiceError):
+    """Admission rejected: the queue is at capacity (or the ``overload``
+    fault forced the rejection).  ``retry_after_s`` is the service's
+    resubmission hint — roughly one batch-round wall time."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class DeadlineExpiredError(ServiceError):
+    """The request's deadline passed before its batch was dispatched.
+    Expired requests are dropped at batch-assembly time, BEFORE any LP is
+    built — they never poison the batch they would have ridden."""
+
+
+class ServiceClosedError(ServiceError):
+    """Admission refused: the service is draining or closed."""
+
+
+class RequestPreemptedError(ServiceError):
+    """The service was preempted (SIGTERM drain) while this request was
+    in flight.  Per-case checkpoints and the request's namespaced
+    ``run_manifest.<rid>.json`` were flushed first — resubmitting the
+    same request id against the same checkpoint directory resumes
+    instead of restarting."""
+
+    def __init__(self, msg: str, manifest_path=None):
+        super().__init__(msg)
+        self.manifest_path = manifest_path
+
+
+class RequestFailedError(ServiceError):
+    """Every case of the request was quarantined by the failure-isolation
+    layer; ``failures`` maps case key -> diagnosis."""
+
+    def __init__(self, failures: Dict):
+        self.failures = dict(failures)
+        lines = [f"  case {k}: {r}" for k, r in self.failures.items()]
+        super().__init__(
+            f"all {len(self.failures)} case(s) of the request failed:\n"
+            + "\n".join(lines))
+
+
+class QueuedRequest:
+    """One admitted submission: the cases to solve, admission metadata,
+    and the future the result is delivered through."""
+
+    __slots__ = ("request_id", "cases", "priority", "deadline", "future",
+                 "seq", "t_submit")
+
+    def __init__(self, request_id: str, cases: Dict, priority: int = 0,
+                 deadline_s: Optional[float] = None, seq: int = 0):
+        self.request_id = str(request_id)
+        self.cases = cases
+        self.priority = int(priority)
+        now = time.monotonic()
+        self.deadline = None if deadline_s is None else now + float(deadline_s)
+        self.future: Future = Future()
+        self.seq = seq
+        self.t_submit = now
+
+    def expired(self) -> bool:
+        return self.deadline is not None and time.monotonic() > self.deadline
+
+
+class AdmissionQueue:
+    """Bounded, priority-then-FIFO admission queue with typed rejection.
+
+    Higher ``priority`` pops first; within a priority level the order is
+    strict FIFO (a monotone sequence number breaks ties, so two equal-
+    priority requests can never reorder).  ``put`` never blocks: a full
+    queue — or an active ``overload`` fault — rejects with
+    :class:`QueueFullError` carrying a retry-after hint, which is the
+    whole backpressure contract (callers retry or shed; the service's
+    memory stays bounded)."""
+
+    def __init__(self, max_depth: int = 64):
+        self.max_depth = int(max_depth)
+        self._cond = threading.Condition()
+        self._heap: List[tuple] = []
+        self._seq = itertools.count()
+        self.closed = False
+        # the retry-after hint tracks the service's recent round wall
+        # time (updated by the server after every batch round)
+        self.retry_after_s = 1.0
+        self.counters = {"admitted": 0, "rejected_full": 0,
+                         "rejected_overload": 0, "rejected_closed": 0,
+                         "expired": 0}
+
+    # ------------------------------------------------------------------
+    def put(self, req: QueuedRequest) -> None:
+        """Admit ``req`` or raise a typed rejection (never blocks)."""
+        with self._cond:
+            if self.closed:
+                self.counters["rejected_closed"] += 1
+                raise ServiceClosedError(
+                    f"request {req.request_id!r} rejected: the service "
+                    "is draining — no new admissions")
+            if faultinject.maybe_overload():
+                self.counters["rejected_overload"] += 1
+                raise QueueFullError(
+                    f"request {req.request_id!r} rejected: queue full "
+                    "(overload fault injection); retry after "
+                    f"{self.retry_after_s:.2f}s",
+                    retry_after_s=self.retry_after_s)
+            if len(self._heap) >= self.max_depth:
+                self.counters["rejected_full"] += 1
+                raise QueueFullError(
+                    f"request {req.request_id!r} rejected: queue depth "
+                    f"{len(self._heap)} at capacity {self.max_depth}; "
+                    f"retry after {self.retry_after_s:.2f}s",
+                    retry_after_s=self.retry_after_s)
+            req.seq = next(self._seq)
+            heapq.heappush(self._heap, (-req.priority, req.seq, req))
+            self.counters["admitted"] += 1
+            self._cond.notify_all()
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._heap)
+
+    def close(self) -> None:
+        """Stop admissions (drain): subsequent ``put`` raises
+        :class:`ServiceClosedError`; pending requests stay takeable."""
+        with self._cond:
+            self.closed = True
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    def take(self, max_batch: int = 64, max_wait_s: float = 0.0,
+             block: bool = True,
+             timeout: Optional[float] = None) -> List[QueuedRequest]:
+        """Drain the next batch of requests, in priority-then-FIFO order.
+
+        Blocks (up to ``timeout``) until at least one request is pending,
+        then holds the batch open for up to ``max_wait_s`` — the
+        continuous-batching window that lets small requests arriving
+        close together share one device dispatch — or until ``max_batch``
+        requests are pending.  Returns ``[]`` when nothing arrived (or
+        the queue closed while empty).
+
+        Requests whose deadline already passed are answered here with
+        :class:`DeadlineExpiredError` and excluded from the batch."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while block and not self._heap and not self.closed:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    break
+                self._cond.wait(remaining if remaining is not None else 0.5)
+            if self._heap and max_wait_s > 0:
+                # batching window: wait for stragglers to coalesce
+                until = time.monotonic() + max_wait_s
+                while len(self._heap) < max_batch and not self.closed:
+                    remaining = until - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+            out: List[QueuedRequest] = []
+            while self._heap and len(out) < max_batch:
+                _, _, req = heapq.heappop(self._heap)
+                if req.expired():
+                    self.counters["expired"] += 1
+                    req.future.set_exception(DeadlineExpiredError(
+                        f"request {req.request_id!r} expired in queue "
+                        "before dispatch"))
+                    continue
+                out.append(req)
+            return out
+
+    def drain_pending(self) -> List[QueuedRequest]:
+        """Pop everything still queued (shutdown path)."""
+        with self._cond:
+            out = [req for (_, _, req) in sorted(self._heap)]
+            self._heap.clear()
+            return out

@@ -1,0 +1,508 @@
+"""ScenarioService: the long-lived serving layer.
+
+``DERVET.solve`` is a cold one-shot batch run — every caller pays device
+warm-up, XLA compiles, and a full sweep even for a single case.  The
+service amortizes all of it across requests:
+
+* **Persistent compile cache** — one :class:`~dervet_tpu.scenario.
+  scenario.SolverCache` lives across rounds, so a structure seen once
+  never re-preconditions or recompiles; the steady state of a hot
+  service is zero compile events per round.
+* **Cross-request continuous batching** — each round coalesces every
+  pending request's window LPs through ONE ``run_dispatch``, whose
+  structure-key grouping batches them across request boundaries into the
+  existing compaction buckets; ``max_wait_s`` / ``max_batch_requests``
+  are the usual continuous-batching knobs.
+* **Bounded admission with backpressure** — a full queue rejects with a
+  typed retry-after error (never unbounded buffering); priorities and
+  per-request deadlines ride the queue.
+* **Graceful drain** — SIGTERM stops admissions immediately, lets the
+  in-flight round finish (or checkpoint, via the PR-2 supervisor), and
+  flushes per-request ``run_manifest.<rid>.json`` slices; the serve CLI
+  then exits 0.
+* **Per-request observability** — every request gets its own namespaced
+  run-health report and solve-ledger slice; the service aggregates queue
+  depth, admission rejects, batch occupancy, request latency p50/p99,
+  and compile-cache hits under :meth:`ScenarioService.metrics`.
+
+``dervet-tpu serve SPOOL_DIR`` runs the file-spool front end: model-
+parameter files dropped into ``SPOOL_DIR/incoming/`` become requests
+(request id = file stem), results land in ``SPOOL_DIR/results/<rid>/``.
+"""
+from __future__ import annotations
+
+import collections
+import re
+import threading
+import time
+from concurrent.futures import Future
+from pathlib import Path
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..scenario.scenario import SolverCache
+from ..utils.errors import PreemptedError, TellUser
+from ..utils.supervisor import RunSupervisor
+from .batcher import BatchRound
+from .queue import (AdmissionQueue, QueuedRequest, QueueFullError,
+                    ServiceClosedError, ServiceError)
+
+# request ids name files (checkpoints, manifests, health reports): the
+# admission boundary rejects anything that could escape the artifact
+# directories or collide after sanitization
+_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+
+class ScenarioService:
+    """Persistent scenario-solving service (in-process).
+
+    Lifecycle: construct -> :meth:`start` (or drive :meth:`run_once`
+    manually, e.g. in tests) -> :meth:`submit` from any thread ->
+    :meth:`drain`/:meth:`close`.  Thread model: one batcher thread runs
+    the rounds; ``submit`` only touches the admission queue."""
+
+    def __init__(self, backend: str = "jax", solver_opts=None,
+                 max_queue_depth: int = 64, max_wait_s: float = 0.25,
+                 max_batch_requests: int = 32, checkpoint_dir=None,
+                 max_cached_structures: int = 64,
+                 gc_checkpoints: bool = True):
+        self.backend = backend
+        self.solver_opts = solver_opts
+        self.max_wait_s = float(max_wait_s)
+        self.max_batch_requests = int(max_batch_requests)
+        self.checkpoint_dir = checkpoint_dir
+        self.max_cached_structures = int(max_cached_structures)
+        # delivered requests' checkpoints/manifest slices are reclaimed
+        # by default (unbounded disk otherwise); failed/preempted
+        # requests always keep theirs for resume
+        self.gc_checkpoints = bool(gc_checkpoints)
+        self.queue = AdmissionQueue(max_queue_depth)
+        # the hot-service core: compiled solvers + preconditioning live
+        # across rounds (see run_dispatch's solver_cache hook), and
+        # pad_grid snaps every coalesced batch onto the pdhg compaction
+        # bucket widths so varying request mixes reuse compiled shapes
+        self.solver_cache = SolverCache(pad_grid=(backend != "cpu"))
+        # drain flag is set from signal context (on_stop must stay
+        # lock-free); the queue is closed later, on a normal thread.
+        # Handlers install only when the OWNER enters the supervisor
+        # (serve loop / tests, main thread); library embedders who never
+        # enter it still get programmatic drain via request_stop().
+        self._draining = threading.Event()
+        self.supervisor = RunSupervisor(install_signals=True,
+                                        on_stop=self._draining.set)
+        self._thread: Optional[threading.Thread] = None
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        # ids with an unresolved future: a resubmission of a live id
+        # would cross-wire results (scenario maps / checkpoints /
+        # manifests key on it), so it is rejected at admission; the id
+        # frees the moment its future resolves
+        self._active_ids: set = set()
+        self._metrics_lock = threading.Lock()
+        # bounded: the percentile surface only needs a recent window,
+        # and a service that never dies must not grow per-request state
+        self._latencies = collections.deque(maxlen=4096)
+        self._rounds = {"count": 0, "requests": 0, "cases": 0,
+                        "windows": 0, "device_groups": 0,
+                        "cross_request_groups": 0, "batch_sum": 0.0,
+                        "compile_events": 0, "round_s": 0.0,
+                        "preempted": 0}
+        self._requests = {"completed": 0, "failed": 0}
+        self.last_round_ledger: Optional[Dict] = None
+        self.device_info: Optional[Dict] = None
+        self._started = False
+
+    # -- admission ------------------------------------------------------
+    def submit(self, cases, *, request_id=None, priority: int = 0,
+               deadline_s: Optional[float] = None) -> Future:
+        """Admit one request (a dict of case key -> ``CaseParams``, or an
+        iterable of cases) and return the future its
+        :class:`~dervet_tpu.results.result.Result` is delivered through.
+
+        Raises :class:`~dervet_tpu.service.queue.QueueFullError` (with a
+        ``retry_after_s`` hint) under backpressure and
+        :class:`~dervet_tpu.service.queue.ServiceClosedError` once the
+        service is draining."""
+        if self._draining.is_set():
+            raise ServiceClosedError(
+                "service is draining — no new admissions")
+        if not isinstance(cases, dict):
+            cases = dict(enumerate(cases))
+        if not cases:
+            raise ValueError("a request needs at least one case")
+        with self._seq_lock:
+            if request_id is None:
+                self._seq += 1
+                request_id = f"r{self._seq:06d}"
+            if not _REQUEST_ID_RE.match(str(request_id)):
+                raise ValueError(
+                    f"request id {request_id!r} must match "
+                    "[A-Za-z0-9._-]{1,64} — it names checkpoint/"
+                    "manifest/health files")
+            if str(request_id) in self._active_ids:
+                raise ValueError(
+                    f"request id {request_id!r} is still in flight — "
+                    "wait for its future (or pick a new id) before "
+                    "resubmitting")
+            self._active_ids.add(str(request_id))
+        req = QueuedRequest(request_id, cases, priority=priority,
+                            deadline_s=deadline_s)
+        req.future.add_done_callback(
+            lambda _f, rid=str(request_id): self._release_id(rid))
+        try:
+            self.queue.put(req)
+        except ServiceError:
+            self._release_id(str(request_id))
+            raise
+        return req.future
+
+    def _release_id(self, rid: str) -> None:
+        with self._seq_lock:
+            self._active_ids.discard(rid)
+
+    def submit_params(self, path, base_path=None, **kwargs) -> Future:
+        """Admit a model-parameters FILE (CSV/JSON/XML) as one request —
+        the serve-loop front end; parsing errors raise here, at
+        admission, not inside the batch."""
+        from ..io.params import Params
+        cases = Params.initialize(path, base_path=base_path)
+        return self.submit(cases, **kwargs)
+
+    # -- batching loop --------------------------------------------------
+    def start(self) -> "ScenarioService":
+        """Warm the device and start the batcher thread."""
+        if self._started:
+            return self
+        if self.backend != "cpu":
+            from ..parallel.mesh import warmup_devices
+            self.device_info = warmup_devices()
+            TellUser.info(
+                f"service: device warm ({self.device_info['n_devices']}x "
+                f"{self.device_info['platform']}:"
+                f"{self.device_info['device_kind']})")
+        self._started = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="dervet-service-batcher")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._draining.is_set():
+            try:
+                self.run_once(block=True, timeout=0.5)
+            except PreemptedError:
+                break            # drain signal landed mid-round
+            except Exception as e:   # a round crash must not kill serving
+                TellUser.error(f"service: batch round errored: {e}")
+        self._fail_pending()
+
+    def run_once(self, block: bool = False,
+                 timeout: Optional[float] = None) -> int:
+        """Run one batch round synchronously; returns the number of
+        requests served.  The manual drive used by tests and by callers
+        embedding the service without the batcher thread."""
+        requests = self.queue.take(max_batch=self.max_batch_requests,
+                                   max_wait_s=self.max_wait_s,
+                                   block=block, timeout=timeout)
+        if not requests:
+            return 0
+        rnd = BatchRound(requests, backend=self.backend,
+                         solver_opts=self.solver_opts,
+                         solver_cache=self.solver_cache,
+                         supervisor=self.supervisor,
+                         checkpoint_dir=self.checkpoint_dir,
+                         on_stats=self._absorb_round_stats,
+                         gc_checkpoints=self.gc_checkpoints)
+        try:
+            rnd.run()
+        finally:
+            self._absorb_request_outcomes(rnd)
+        return len(rnd.requests)
+
+    def _absorb_round_stats(self, rnd: BatchRound) -> None:
+        """Round-level bookkeeping, fired by the batcher BEFORE any
+        request future resolves — so metrics()/last_round_ledger are
+        current the moment a client wakes on its result."""
+        st = rnd.stats
+        with self._metrics_lock:
+            self._rounds["count"] += 1
+            if rnd.preempted:
+                self._rounds["preempted"] += 1
+            for k in ("requests", "cases", "windows", "device_groups",
+                      "cross_request_groups", "compile_events"):
+                self._rounds[k] += int(st.get(k, 0))
+            self._rounds["batch_sum"] += float(
+                st.get("mean_batch", 0.0)) * int(st.get("device_groups", 0))
+            self._rounds["round_s"] += float(st.get("round_s", 0.0))
+        if rnd.ledger is not None:
+            self.last_round_ledger = rnd.ledger
+        if st.get("round_s"):
+            # the backpressure retry-after hint tracks real round walls
+            self.queue.retry_after_s = max(0.05, float(st["round_s"]))
+        # bound the structure cache: a service fed unbounded distinct
+        # structures must not grow device/host memory forever — clearing
+        # trades a re-precondition for boundedness (same policy as the
+        # structure-key memo)
+        if len(self.solver_cache.solvers) > self.max_cached_structures:
+            TellUser.warning(
+                f"service: solver cache at "
+                f"{len(self.solver_cache.solvers)} structures (bound "
+                f"{self.max_cached_structures}) — clearing")
+            self.solver_cache.solvers.clear()
+
+    def _absorb_request_outcomes(self, rnd: BatchRound) -> None:
+        """Per-request accounting after delivery — including requests
+        answered during batch assembly (expiry, duplicate id, assembly
+        failure), so admitted == completed + failed + pending always
+        reconciles."""
+        with self._metrics_lock:
+            for req in list(rnd.requests) + list(rnd.answered_early):
+                fut = req.future
+                if fut.done() and fut.exception() is None:
+                    self._requests["completed"] += 1
+                    self._latencies.append(
+                        time.monotonic() - req.t_submit)
+                elif fut.done():
+                    self._requests["failed"] += 1
+
+    # -- shutdown -------------------------------------------------------
+    def _fail_pending(self) -> None:
+        """Answer everything still queued with the typed draining error
+        (they never started; there is nothing to resume)."""
+        self.queue.close()
+        for req in self.queue.drain_pending():
+            if not req.future.done():
+                req.future.set_exception(ServiceClosedError(
+                    f"request {req.request_id!r} not started before "
+                    "service drain — resubmit to a live service"))
+
+    def request_stop(self, signum=None) -> None:
+        """Programmatic drain trigger (what SIGTERM does in the serve
+        loop): admissions close immediately, the in-flight round finishes
+        or checkpoints, queued requests are answered as not-started."""
+        self.supervisor.request_stop(signum)
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Stop admissions and wait for the batcher to go quiet.  Waits
+        for the in-flight round by default — abandoning it would break
+        the resumable-drain contract (futures unanswered, manifests
+        unflushed); a second SIGTERM is the documented escape hatch.
+        With a ``timeout``, a still-running round is reported loudly and
+        the thread handle kept so a later drain can finish the job."""
+        self.request_stop()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                TellUser.warning(
+                    f"service: batcher still mid-round after {timeout:g}s "
+                    "drain timeout — in-flight requests are NOT yet "
+                    "answered; drain again (or send a second signal to "
+                    "abort)")
+                return
+            self._thread = None
+        else:
+            self._fail_pending()
+
+    def close(self) -> None:
+        self.drain()
+
+    def __enter__(self) -> "ScenarioService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- observability --------------------------------------------------
+    def metrics(self) -> Dict:
+        """Service-level metrics: queue depth/rejects, request counts,
+        latency percentiles, batch occupancy, compile-cache hits."""
+        with self._metrics_lock:
+            lat = np.asarray(self._latencies, dtype=float)
+            rounds = dict(self._rounds)
+            requests = dict(self._requests)
+        groups = rounds.pop("batch_sum"), rounds["device_groups"]
+        cache = self.solver_cache
+        lookups = cache.builds + cache.hits
+        return {
+            "queue": {"depth": self.queue.depth(),
+                      "max_depth": self.queue.max_depth,
+                      "closed": self.queue.closed,
+                      **self.queue.counters},
+            "requests": {**requests,
+                         "pending": self.queue.depth()},
+            "rounds": rounds,
+            "batch_occupancy": {
+                "mean_windows_per_device_batch":
+                    round(groups[0] / groups[1], 2) if groups[1] else 0.0,
+                "cross_request_groups": rounds["cross_request_groups"],
+            },
+            "latency_s": {
+                "n": int(lat.size),
+                "p50": round(float(np.percentile(lat, 50)), 4)
+                if lat.size else None,
+                "p99": round(float(np.percentile(lat, 99)), 4)
+                if lat.size else None,
+                "max": round(float(lat.max()), 4) if lat.size else None,
+            },
+            "compile_cache": {
+                "solver_builds": cache.builds,
+                "solver_hits": cache.hits,
+                "hit_rate": round(cache.hits / lookups, 4)
+                if lookups else None,
+                "structures_cached": len(cache.solvers),
+                "compile_events_total": rounds["compile_events"],
+            },
+            "service": {"backend": self.backend,
+                        "started": self._started,
+                        "draining": self._draining.is_set(),
+                        "device": self.device_info},
+        }
+
+
+# ---------------------------------------------------------------------------
+# `dervet-tpu serve`: the file-spool serving loop
+# ---------------------------------------------------------------------------
+
+def serve_main(argv=None) -> int:
+    """CLI loop: watch ``SPOOL/incoming/`` for model-parameter files,
+    serve each as a request, write results to ``SPOOL/results/<rid>/``.
+    SIGTERM/SIGINT drains gracefully and exits 0 (resumable per-request
+    manifests under ``--checkpoint-dir``); a second signal aborts."""
+    import argparse
+    import json
+
+    from ..utils.supervisor import atomic_write
+
+    parser = argparse.ArgumentParser(
+        prog="dervet-tpu serve",
+        description="persistent scenario service: cross-request "
+                    "continuous batching over a file spool")
+    parser.add_argument("spool_dir",
+                        help="spool root (incoming/, results/, done/, "
+                             "failed/ are created under it)")
+    parser.add_argument("--backend", default="jax",
+                        choices=["jax", "cpu"],
+                        help="dispatch backend for every request "
+                             "(default jax — a hot service amortizes "
+                             "the compile bill the auto heuristic "
+                             "exists to avoid)")
+    parser.add_argument("--base-path", default=None,
+                        help="root for relative referenced-data paths")
+    parser.add_argument("--max-queue-depth", type=int, default=64)
+    parser.add_argument("--max-wait-ms", type=float, default=250.0,
+                        help="continuous-batching window: how long a "
+                             "round holds for stragglers to coalesce")
+    parser.add_argument("--max-batch-requests", type=int, default=32)
+    parser.add_argument("--poll-s", type=float, default=0.5,
+                        help="incoming-directory scan interval")
+    parser.add_argument("--checkpoint-dir", default=None,
+                        help="resume checkpoints + per-request manifests "
+                             "(default: SPOOL/checkpoints)")
+    parser.add_argument("--once", action="store_true",
+                        help="serve the files already in incoming/, "
+                             "then drain and exit (smoke/CI mode)")
+    args = parser.parse_args(argv)
+
+    spool = Path(args.spool_dir)
+    incoming = spool / "incoming"
+    results_root = spool / "results"
+    done_dir = spool / "done"
+    failed_dir = spool / "failed"
+    for d in (incoming, results_root, done_dir, failed_dir):
+        d.mkdir(parents=True, exist_ok=True)
+
+    service = ScenarioService(
+        backend=args.backend,
+        max_queue_depth=args.max_queue_depth,
+        max_wait_s=args.max_wait_ms / 1000.0,
+        max_batch_requests=args.max_batch_requests,
+        checkpoint_dir=args.checkpoint_dir or spool / "checkpoints")
+    service.start()
+    pending: Dict[str, Future] = {}
+
+    def _finish(path: Path, rid: str, fut: Future) -> None:
+        """Done-callback: persist the request's outputs (or its error)
+        and move the input file out of incoming/."""
+        try:
+            err = fut.exception()
+            if err is None:
+                fut.result().save_as_csv(results_root / rid)
+                path.replace(done_dir / path.name)
+                TellUser.info(f"serve: request {rid} done -> "
+                              f"{results_root / rid}")
+            else:
+                atomic_write(failed_dir / f"{path.name}.error.txt",
+                             f"{type(err).__name__}: {err}\n")
+                path.replace(failed_dir / path.name)
+                TellUser.error(f"serve: request {rid} failed: {err}")
+        except Exception as e:          # never kill the batcher thread
+            TellUser.error(f"serve: could not finalize request {rid}: {e}")
+        finally:
+            # release the id so a new same-named drop is a new request
+            pending.pop(rid, None)
+
+    # the serve loop owns the signal handlers (main thread): first
+    # SIGTERM/SIGINT -> graceful drain + exit 0, second -> abort
+    with service.supervisor:
+        while not service.supervisor.stop_requested():
+            submitted_any = False
+            deferred = False
+            for path in sorted(incoming.glob("*")):
+                if path.suffix.lower() not in (".csv", ".json", ".xml"):
+                    continue
+                # file stems become request ids, which name artifact
+                # files — sanitize to the admission-safe alphabet (two
+                # stems colliding post-sanitization: the second is
+                # rejected as a duplicate and parked in failed/)
+                rid = re.sub(r"[^A-Za-z0-9._-]", "_",
+                             path.stem)[:64] or "req"
+                if rid in pending:
+                    continue
+                try:
+                    fut = service.submit_params(path,
+                                                base_path=args.base_path,
+                                                request_id=rid)
+                except QueueFullError as e:
+                    TellUser.warning(
+                        f"serve: {rid} deferred (queue full), retrying "
+                        f"in {e.retry_after_s:.1f}s")
+                    deferred = True
+                    break               # leave in incoming/, rescan later
+                except ServiceClosedError:
+                    break
+                except Exception as e:  # unparseable input: park it
+                    atomic_write(failed_dir / f"{path.name}.error.txt",
+                                 f"{type(e).__name__}: {e}\n")
+                    path.replace(failed_dir / path.name)
+                    TellUser.error(f"serve: {rid} rejected at admission: "
+                                   f"{e}")
+                    continue
+                pending[rid] = fut
+                fut.add_done_callback(
+                    lambda f, p=path, r=rid: _finish(p, r, f))
+                submitted_any = True
+            if args.once:
+                if deferred and not service.supervisor.stop_requested():
+                    # --once must still serve EVERY input: rescan the
+                    # deferred leftovers once backpressure eases instead
+                    # of silently exiting 0 with files unprocessed
+                    service.supervisor.wait_stop(min(args.poll_s, 1.0))
+                    continue
+                for fut in list(pending.values()):
+                    while not fut.done() and \
+                            not service.supervisor.stop_requested():
+                        time.sleep(0.05)
+                break
+            if not submitted_any:
+                service.supervisor.wait_stop(args.poll_s)
+        service.drain()
+    metrics = service.metrics()
+    atomic_write(spool / "service_metrics.json",
+                 json.dumps(metrics, indent=2))
+    TellUser.info(
+        f"serve: drained; {metrics['requests']['completed']} request(s) "
+        f"completed, {metrics['requests']['failed']} failed, "
+        f"{metrics['queue']['rejected_full'] + metrics['queue']['rejected_overload']} "
+        "rejected — metrics in service_metrics.json")
+    return 0

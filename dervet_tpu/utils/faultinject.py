@@ -54,6 +54,17 @@ Two activation paths:
                                          numbers are wrong, and only the
                                          independent certifier can catch
                                          it ('all' matches every window)
+      DERVET_TPU_FAULT_OVERLOAD=1        force the scenario service's
+                                         admission queue to report FULL —
+                                         every submit is rejected with the
+                                         typed queue-full error (clean
+                                         backpressure, never a crash), so
+                                         overload handling and client
+                                         retry-after logic are drillable;
+                                         DERVET_TPU_FAULT_OVERLOAD_N=2
+                                         bounds it to the first 2
+                                         admissions (then the queue
+                                         behaves normally)
 
 Faults are observational flips, input corruptions, delays, and signals
 only — the injector never touches solver internals, so the production
@@ -79,6 +90,7 @@ EVENT_HANG = "hang"        # solve call put to sleep past the watchdog
 EVENT_SLOW = "slow_solve"  # solve call delayed (bounded)
 EVENT_PREEMPT = "preempt"  # self-delivered SIGTERM at a batch boundary
 EVENT_CORRUPT = "corrupt_solution"  # solution vector perturbed post-solve
+EVENT_OVERLOAD = "overload"         # service admission forced to reject
 
 
 def _norm(values) -> frozenset:
@@ -106,7 +118,9 @@ class FaultPlan:
                  hang: Iterable = (), hang_seconds: float = 60.0,
                  slow: Iterable = (), slow_seconds: float = 1.0,
                  preempt_after: Optional[int] = None,
-                 corrupt: Iterable = (), corrupt_scale: float = 0.05):
+                 corrupt: Iterable = (), corrupt_scale: float = 0.05,
+                 overload: bool = False,
+                 overload_n: Optional[int] = None):
         self.nonconverge = _norm(nonconverge)
         self.rungs = _norm(rungs)
         self.poison_cases = _norm(poison_cases)
@@ -124,6 +138,11 @@ class FaultPlan:
         # window labels, honors ``rungs`` like nonconverge)
         self.corrupt = _norm(corrupt)
         self.corrupt_scale = float(corrupt_scale)
+        # overload: admission-queue rejections (service backpressure drill);
+        # overload_n bounds the drill to the first N admissions, None = all
+        self.overload = bool(overload)
+        self.overload_n = None if overload_n is None else int(overload_n)
+        self._overload_fired = 0
         self._preempt_fired = False
         self.fired: List[Tuple[str, str]] = []   # (rung/event, label/case)
 
@@ -171,6 +190,17 @@ class FaultPlan:
             return True
         return False
 
+    def overload_due(self) -> bool:
+        """Should the next service admission be rejected as queue-full?"""
+        if not self.overload:
+            return False
+        if self.overload_n is not None and \
+                self._overload_fired >= self.overload_n:
+            return False
+        self._overload_fired += 1
+        self.fired.append((EVENT_OVERLOAD, str(self._overload_fired)))
+        return True
+
     def preempt_due(self, batches_done: int) -> bool:
         if self.preempt_after is None or self._preempt_fired or \
                 batches_done < self.preempt_after:
@@ -192,7 +222,8 @@ _ENV_VARS = ("DERVET_TPU_FAULT_NONCONVERGE", "DERVET_TPU_FAULT_POISON_CASE",
              "DERVET_TPU_FAULT_HANG", "DERVET_TPU_FAULT_HANG_S",
              "DERVET_TPU_FAULT_SLOW", "DERVET_TPU_FAULT_SLOW_S",
              "DERVET_TPU_FAULT_PREEMPT_AFTER", "DERVET_TPU_FAULT_CORRUPT",
-             "DERVET_TPU_FAULT_CORRUPT_SCALE")
+             "DERVET_TPU_FAULT_CORRUPT_SCALE", "DERVET_TPU_FAULT_OVERLOAD",
+             "DERVET_TPU_FAULT_OVERLOAD_N")
 _ENV_PLAN: Optional[FaultPlan] = None
 _ENV_SNAPSHOT: Optional[tuple] = None
 
@@ -205,8 +236,11 @@ def _plan_from_env() -> Optional[FaultPlan]:
     sl = os.environ.get("DERVET_TPU_FAULT_SLOW")
     pa = os.environ.get("DERVET_TPU_FAULT_PREEMPT_AFTER")
     cr = os.environ.get("DERVET_TPU_FAULT_CORRUPT")
-    if not (nc or pc or cf or hg or sl or pa or cr):
+    ov = os.environ.get("DERVET_TPU_FAULT_OVERLOAD", "").strip().lower()
+    ov_on = ov not in ("", "0", "false", "off")
+    if not (nc or pc or cf or hg or sl or pa or cr or ov_on):
         return None
+    ov_n = os.environ.get("DERVET_TPU_FAULT_OVERLOAD_N")
     rungs = os.environ.get("DERVET_TPU_FAULT_RUNGS", RUNG_SOLVE)
     return FaultPlan(
         nonconverge=nc or (), rungs=rungs,
@@ -218,7 +252,9 @@ def _plan_from_env() -> Optional[FaultPlan]:
         preempt_after=int(pa) if pa else None,
         corrupt=cr or (),
         corrupt_scale=float(
-            os.environ.get("DERVET_TPU_FAULT_CORRUPT_SCALE", 0.05)))
+            os.environ.get("DERVET_TPU_FAULT_CORRUPT_SCALE", 0.05)),
+        overload=ov_on,
+        overload_n=int(ov_n) if ov_n else None)
 
 
 def get_plan() -> Optional[FaultPlan]:
@@ -312,6 +348,16 @@ def maybe_corrupt(label, x, rung: str,
     if plan is None or not plan.corrupt_due(label, rung):
         return None
     return corrupt_array(x, label, plan.corrupt_scale)
+
+
+def maybe_overload() -> bool:
+    """``overload`` injection point at the service admission queue: when
+    targeted, the admission is rejected exactly as a genuinely full queue
+    would reject it (typed queue-full error with a retry-after hint) —
+    so backpressure handling and client retry logic are drillable without
+    actually saturating a queue."""
+    plan = get_plan()
+    return plan is not None and plan.overload_due()
 
 
 def maybe_preempt(batches_done: int) -> bool:

@@ -109,11 +109,17 @@ def atomic_write(path, data) -> None:
 # Sweep-level resume manifest
 # ---------------------------------------------------------------------------
 
-def manifest_path(checkpoint_dir) -> Path:
-    return Path(checkpoint_dir) / MANIFEST_NAME
+def manifest_path(checkpoint_dir, request_id=None) -> Path:
+    # request-namespaced manifests (run_manifest.<rid>.json) are the
+    # scenario service's per-request resume/reporting slices; the bare
+    # name stays the whole-sweep manifest the resume path consults
+    from ..io.summary import run_artifact_name
+    return Path(checkpoint_dir) / run_artifact_name(MANIFEST_NAME,
+                                                    request_id)
 
 
-def write_manifest(checkpoint_dir, scenarios, backend: str = "") -> Dict:
+def write_manifest(checkpoint_dir, scenarios, backend: str = "",
+                   request_id=None) -> Dict:
     """Write ``run_manifest.json``: the sweep-level resume picture.
 
     Per case: ``status`` (``done`` — every window solved, or no dispatch
@@ -122,7 +128,11 @@ def write_manifest(checkpoint_dir, scenarios, backend: str = "") -> Dict:
     diagnosis), the input ``fingerprint`` the per-case checkpoint is
     keyed by, and window counts.  Keys are case ids as strings; colliding
     caller-supplied ids overwrite each other here, which is safe — resume
-    re-verifies the fingerprint per scenario before skipping anything."""
+    re-verifies the fingerprint per scenario before skipping anything.
+
+    ``request_id`` (scenario service) writes a per-request slice under a
+    namespaced filename instead — concurrent requests in one process get
+    their own manifests and cannot clobber each other's."""
     cases = {}
     for s in scenarios:
         total = len(s.windows)
@@ -142,7 +152,9 @@ def write_manifest(checkpoint_dir, scenarios, backend: str = "") -> Dict:
         }
     manifest = {"version": MANIFEST_VERSION, "backend": backend,
                 "cases": cases}
-    atomic_write(manifest_path(checkpoint_dir),
+    if request_id is not None:
+        manifest["request_id"] = str(request_id)
+    atomic_write(manifest_path(checkpoint_dir, request_id),
                  json.dumps(manifest, indent=2))
     return manifest
 
@@ -255,20 +267,36 @@ class RunSupervisor:
 
     SIGNALS = (signal.SIGTERM, signal.SIGINT)
 
-    def __init__(self, install_signals: bool = True):
+    def __init__(self, install_signals: bool = True, on_stop=None):
         self._stop = threading.Event()
         self._install = install_signals
         self._previous: Dict[int, object] = {}
         self.stop_signal: Optional[int] = None
         self.watchdog = SolveWatchdog.from_env()
+        # on_stop: invoked ONCE when the stop is first requested — the
+        # scenario service uses it to close admissions the instant the
+        # drain signal lands.  It may run in signal-handler context, so
+        # it must be lock-free (set events/flags only).
+        self._on_stop = on_stop
 
     # -- stop flag ------------------------------------------------------
     def stop_requested(self) -> bool:
         return self._stop.is_set()
 
+    def wait_stop(self, timeout: Optional[float] = None) -> bool:
+        """Block until a stop is requested (or ``timeout``); returns the
+        flag state — the poll primitive for service/serve loops."""
+        return self._stop.wait(timeout)
+
     def request_stop(self, signum: Optional[int] = None) -> None:
+        first = not self._stop.is_set()
         self.stop_signal = signum
         self._stop.set()
+        if first and self._on_stop is not None:
+            try:
+                self._on_stop()
+            except Exception:
+                pass    # a failing hook must never break the stop path
 
     # -- signal plumbing ------------------------------------------------
     def _on_signal(self, signum, frame) -> None:

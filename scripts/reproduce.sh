@@ -34,6 +34,11 @@ else
     echo "=== 4. SKIPPED: reference checkout not found at $REF ==="
 fi
 
+echo "=== 4b. serving smoke: concurrent requests through the scenario"
+echo "        service (expect ok:true, 100% certified, coalesced_groups"
+echo "        >= 1, warm_repeat_compile_events 0, exit 0) ==="
+JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+
 echo "=== 5. driver hooks: single-chip compile + multi-chip dryrun ==="
 python __graft_entry__.py
 
