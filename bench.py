@@ -223,6 +223,11 @@ def main() -> None:
             legs["serving"] = serving_leg()
         except Exception as e:          # noqa: BLE001
             legs["serving"] = {"error": str(e)[:300]}
+    if int(os.environ.get("BENCH_CHAOS", "1")):
+        try:
+            legs["serving_chaos"] = serving_chaos_leg()
+        except Exception as e:          # noqa: BLE001
+            legs["serving_chaos"] = {"error": str(e)[:300]}
     config["legs"] = legs
 
     # scale the target linearly if running fewer scenarios than the baseline
@@ -614,6 +619,58 @@ def serving_leg() -> dict:
         "queue": {k: m["queue"][k] for k in
                   ("admitted", "rejected_full", "rejected_overload",
                    "expired")},
+    }
+
+
+def serving_chaos_leg() -> dict:
+    """Self-healing proof: the seeded chaos/soak drill
+    (``scripts/chaos_soak.py``) against a live service — overload bursts
+    (load-shed degraded answers), watchdog hangs, corrupt solutions,
+    device losses, poison requests — published under
+    ``legs.serving_chaos``.  Gates: zero lost requests, zero uncertified
+    answers stamped certified, bounded p99 through the storm, exit-0
+    recovery.  The bench leg runs a reduced request count (the full 200
+    runs in CI's ``chaos-soak`` job) and reports the degraded- vs
+    certified-tier latency split."""
+    import subprocess
+    import sys as _sys
+    from pathlib import Path
+
+    n_req = int(os.environ.get("BENCH_CHAOS_REQUESTS", "60"))
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "0"))
+    cmd = [_sys.executable,
+           str(Path(__file__).resolve().parent / "scripts"
+               / "chaos_soak.py"),
+           "--seed", str(seed), "--requests", str(n_req),
+           "--skip-sigkill"]
+    t0 = time.time()
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=1800,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"chaos soak exited {proc.returncode}: "
+            f"{proc.stderr.strip()[-300:]}")
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    soak = report["soak"]
+    log(f"bench[serving_chaos]: {soak['requests']} seeded requests "
+        f"under fault schedule in {time.time() - t0:.1f}s — "
+        f"{soak['outcomes']['completed']} certified / "
+        f"{soak['outcomes']['degraded']} degraded / "
+        f"{soak['outcomes']['failed_typed']} typed failures, "
+        f"p50/p99 {soak['latency_p50_s']}/{soak['latency_p99_s']}s; "
+        f"recovery: {soak['resilience']['backend_recovery']['reinits']} "
+        f"re-inits, {soak['resilience']['poison_quarantine']['quarantined']} "
+        "poison quarantines; zero lost requests")
+    return {
+        "requests": soak["requests"],
+        "outcomes": soak["outcomes"],
+        "faults": soak["faults"],
+        "latency_p50_s": soak["latency_p50_s"],
+        "latency_p99_s": soak["latency_p99_s"],
+        "resilience": soak["resilience"],
+        "preempt": report.get("preempt"),
+        "elapsed_s": round(time.time() - t0, 1),
     }
 
 

@@ -103,7 +103,12 @@ def log_health_report(report: Dict) -> None:
     """One TellUser line summarizing the run's solver health; WARNING when
     anything degraded, INFO when the run was fully clean."""
     t = report["windows"]
-    msg = ("run health: "
+    # degraded-fidelity answers (load-shed screening tier) must never
+    # read as healthy certified output in the log trail
+    fidelity = report.get("fidelity")
+    prefix = (f"[fidelity: {fidelity}] "
+              if fidelity not in (None, "certified") else "")
+    msg = (f"{prefix}run health: "
            f"{t['clean']} clean / {t['inaccurate']} inaccurate-accepted / "
            f"{t['retried']} retried / {t['cpu_fallback']} CPU-fallback / "
            f"{t['quarantined']} quarantined / "
@@ -130,5 +135,11 @@ def log_health_report(report: Dict) -> None:
                 f"{', '.join(report['cases_quarantined'])}: "
                 + "; ".join(f"case {k}: {r}" for k, r in
                             report["quarantine_reasons"].items()))
-    degraded = any(t[k] for k in HEALTH_KEYS if k != "clean")
+    breakers = report.get("breakers") or {}
+    tripped = sorted(name for name, b in breakers.items()
+                     if b.get("state") != "closed")
+    if tripped:
+        msg += f"; OPEN breaker(s): {', '.join(tripped)}"
+    degraded = any(t[k] for k in HEALTH_KEYS if k != "clean") \
+        or bool(prefix) or bool(tripped)
     (TellUser.warning if degraded else TellUser.info)(msg)

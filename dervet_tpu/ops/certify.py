@@ -51,9 +51,11 @@ Env knobs (all optional)::
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import hashlib
 import os
+import threading as _threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -107,10 +109,35 @@ _ENV_VARS = ("DERVET_TPU_CERT", "DERVET_TPU_CERT_EPS_REL",
 _POLICY_MEMO: Optional[CertPolicy] = None
 _POLICY_SNAPSHOT: Optional[tuple] = None
 
+# thread-local policy override (service degraded tier): scoping the
+# override to the DISPATCHING thread means a concurrent independent
+# solve on another thread keeps its own env-derived policy — a
+# process-global flip (env var) would silently strip certification
+# from bystanders.  Dispatch-internal pool workers receive the policy
+# EXPLICITLY (resolve_group's ``policy`` parameter, captured once on
+# the dispatching thread), so the override composes with the pipeline.
+_TLS = _threading.local()
+
+
+@contextlib.contextmanager
+def policy_override(policy: CertPolicy):
+    """Install ``policy`` as this THREAD's active certification policy
+    for the duration (see the thread-local note above)."""
+    prev = getattr(_TLS, "override", None)
+    _TLS.override = policy
+    try:
+        yield policy
+    finally:
+        _TLS.override = prev
+
 
 def policy_from_env() -> CertPolicy:
-    """The active policy, memoized per env-knob snapshot (the hot path
-    consults it once per window group)."""
+    """The active policy: this thread's ``policy_override`` if one is
+    installed, else the env-knob policy (memoized per snapshot — the
+    hot path consults it once per window group)."""
+    override = getattr(_TLS, "override", None)
+    if override is not None:
+        return override
     global _POLICY_MEMO, _POLICY_SNAPSHOT
     snap = tuple(os.environ.get(k) for k in _ENV_VARS)
     if snap == _POLICY_SNAPSHOT and _POLICY_MEMO is not None:

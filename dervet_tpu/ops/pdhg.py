@@ -563,6 +563,28 @@ class PDHGOptions:
     # projections); force full-f32 matmuls for the K matvecs.
     precision: jax.lax.Precision = jax.lax.Precision.HIGHEST
 
+    @classmethod
+    def screening(cls, base: Optional["PDHGOptions"] = None,
+                  max_iters: int = 4096) -> "PDHGOptions":
+        """The BOOST-style low-fidelity screening tier (PAPERS.md:
+        arxiv 2501.10842): loose tolerances + a short, hard iteration
+        budget.  Used by the sizing sweep's candidate screen and by the
+        scenario service's load-shedding degraded-answer tier — a
+        screening solution ranks candidates / sketches a dispatch but is
+        NEVER certified; callers must mark results degraded and route
+        anything decision-grade back through the full tier.  The relaxed
+        ``inaccurate_factor`` accepts whatever the budget reached — a
+        screening solve 'failing' would defeat its purpose (shedding
+        load), so it exits with its best iterate and an honest residual
+        instead of climbing the escalation ladder."""
+        base = base if base is not None else cls()
+        return dataclasses.replace(
+            base, eps_rel=1e-2, eps_abs=1e-3,
+            max_iters=int(max_iters),
+            inaccurate_factor=1e6,
+            # screening batches are throwaway: never bill CPU rescues
+            cpu_rescue_after=None)
+
 
 class PDHGResult(NamedTuple):
     x: jax.Array          # (..., n) unscaled primal solution

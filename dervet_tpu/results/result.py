@@ -49,6 +49,13 @@ class Result:
         # serving layer: request wall-clock latency (submit -> result),
         # recorded by the service batcher
         self.request_latency_s: Optional[float] = None
+        # serving layer: answer fidelity — "certified" is the normal
+        # tier; "degraded" marks a load-shed screening answer (loose
+        # tolerance, short budget, NO float64 certificate) that clients
+        # should treat as an estimate and resubmit for a certified
+        # answer (see resubmit_hint)
+        self.fidelity: str = "certified"
+        self.resubmit_hint: Optional[str] = None
 
     def build_instance(self, scenario) -> "CaseResult":
         """Build (but do not register) one case's result frames — the

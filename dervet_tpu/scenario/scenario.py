@@ -1453,6 +1453,10 @@ def guard_items(items):
     for s, ctx, lp in items:
         if s.quarantine is not None:
             continue
+        # poison_case fault: a targeted case CRASHES its dispatch (an
+        # uncaught runtime error, not a guard-absorbed NaN) — the shape
+        # the service's poison-request quarantine attributes
+        faultinject.maybe_crash_case(s.case.case_id)
         faultinject.maybe_poison(s.case.case_id, lp)
         err = validate_lp_inputs(lp, ctx.label)
         if err is not None:
@@ -1501,7 +1505,8 @@ def _guarded_solve(watchdog, rung_desc: str, lps, labels, call):
 
 def resolve_group(items, backend: str, solver_opts, key=None,
                   cache: Optional[SolverCache] = None, watchdog=None,
-                  staged: Optional[StagedGroupData] = None, ledger=None):
+                  staged: Optional[StagedGroupData] = None, ledger=None,
+                  board=None, policy=None):
     """Solve a window group with the per-window escalation ladder.
 
     ``items`` is a list of ``(scenario, ctx, lp)`` (structure-identical
@@ -1520,7 +1525,14 @@ def resolve_group(items, backend: str, solver_opts, key=None,
 
     Fault injection (utils.faultinject) flips observed convergence here —
     after the real solve, before the ladder — so tests drive every
-    recovery rung through the exact production path."""
+    recovery rung through the exact production path.
+
+    ``board`` (a ``utils.breaker.BreakerBoard``, service callers only)
+    gates the escalation rungs through circuit breakers: certification
+    verdicts are recorded under ``certify``, and ``_escalate`` consults/
+    records the ``retry_rung`` / ``cpu_rung`` breakers — a rung whose
+    recent failure rate tripped its breaker is skipped (the members fall
+    through to the next healthy rung) until a half-open probe succeeds."""
     from ..ops.pdhg import STATUS_CONVERGED, STATUS_INACCURATE, \
         STATUS_ITER_LIMIT
     lps = [lp for (_, _, lp) in items]
@@ -1535,7 +1547,10 @@ def resolve_group(items, backend: str, solver_opts, key=None,
                     if getattr(s, "request_id", None) is not None})
     if _reqs:
         meta["requests"] = _reqs
-    policy = certify.policy_from_env()
+    # explicit policy wins (the dispatch driver captures it once on the
+    # dispatching thread, where a thread-local override may be active —
+    # pool workers would otherwise read their own, un-overridden env)
+    policy = policy if policy is not None else certify.policy_from_env()
     # the dual block leaves the device ONLY when the certification policy
     # asks for dual-side verification (DERVET_TPU_CERT_DUAL=1)
     y_box: Optional[dict] = ({} if (policy.enabled and policy.check_dual
@@ -1549,7 +1564,9 @@ def resolve_group(items, backend: str, solver_opts, key=None,
 
     def _call():
         # hang/slow faults sleep INSIDE the guarded closure, exactly
-        # where a wedged device call would be observed
+        # where a wedged device call would be observed; device_loss
+        # raises from the same spot a real XlaRuntimeError would
+        faultinject.maybe_device_loss()
         faultinject.maybe_sleep(labels, faultinject.RUNG_SOLVE)
         return solve_group(lps[0], lps, backend, solver_opts, key=key,
                            cache=cache, labels=labels, staged=staged,
@@ -1599,6 +1616,8 @@ def resolve_group(items, backend: str, solver_opts, key=None,
             cert = _certify_and_record(
                 s, ctx.label, lp, xs[i], objs[i], policy,
                 y=(ys[i] if ys is not None else None))
+            if board is not None:
+                board.record("certify", cert.accepted)
             if not cert.accepted:
                 ok[i] = False
                 cert_rejected.add(i)
@@ -1622,7 +1641,7 @@ def resolve_group(items, backend: str, solver_opts, key=None,
     if fail_idx:
         _escalate(items, fail_idx, xs, objs, ok, diags, statuses,
                   backend, solver_opts, key, cache, watchdog, ledger=ledger,
-                  policy=policy, cert_rejected=cert_rejected)
+                  policy=policy, cert_rejected=cert_rejected, board=board)
     if policy.enabled and cert_rejected:
         # windows whose LAST certificate still rejected after the full
         # ladder: counted here (their case quarantines in apply_subgroup)
@@ -1647,7 +1666,7 @@ def resolve_group(items, backend: str, solver_opts, key=None,
 
 def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
               solver_opts, key, cache, watchdog=None, ledger=None,
-              policy=None, cert_rejected=None) -> None:
+              policy=None, cert_rejected=None, board=None) -> None:
     """Escalation ladder for a group's failed members (mutates the result
     lists in place).
 
@@ -1700,6 +1719,15 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
     # ---- rung 1: boosted-budget retry of the failed members only ----
     retry_idx = [i for i in fail_idx
                  if statuses[i] != STATUS_PRIMAL_INFEASIBLE]
+    if retry_idx and board is not None and not board.allow("retry_rung"):
+        # circuit breaker: the retry rung's recent failure rate tripped
+        # it — stop feeding the sick rung, fall straight through to the
+        # CPU fallback (the healthy rung) until a half-open probe heals
+        TellUser.warning(
+            f"escalation: retry-rung breaker OPEN — {len(retry_idx)} "
+            "failed window(s) skip the boosted-budget retry and go "
+            "straight to the exact CPU fallback")
+        retry_idx = []
     if retry_idx:
         base = solver_opts or PDHGOptions()
         boosted = dataclasses.replace(
@@ -1765,11 +1793,15 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
                     items[i][0], label, items[i][2], rxs[j], robjs[j],
                     policy, y=(rys[j] if rys is not None else None),
                     was_rejected=(i in cert_rejected))
+                if board is not None:
+                    board.record("certify", cert.accepted)
                 if not cert.accepted:
                     rok[j] = False
                     cert_rejected.add(i)
                     rdiags[j] = (f"{certify.REJECT_DIAG_PREFIX} retry "
                                  f"solution rejected: {cert.reason}")
+            if board is not None:
+                board.record("retry_rung", bool(rok[j]))
             if rok[j]:
                 xs[i], objs[i], ok[i] = rxs[j], robjs[j], True
                 diags[i], statuses[i] = rdiags[j], rstatuses[j]
@@ -1785,11 +1817,22 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
     # ---- rung 2: exact CPU fallback, one member at a time ----
     t_rung2 = time.perf_counter()
     rung2_idx = [i for i in fail_idx if not ok[i]]
+    if rung2_idx and board is not None and not board.allow("cpu_rung"):
+        # circuit breaker: the HiGHS fallback rung itself is sick
+        # (crashing / hanging / cert-rejecting) — quarantining fast
+        # beats wedging every round on a dead rung; the half-open
+        # probe re-opens it once it recovers
+        TellUser.warning(
+            f"escalation: CPU-fallback breaker OPEN — {len(rung2_idx)} "
+            "window(s) skip the exact CPU rung and quarantine directly")
+        rung2_idx = []
     for i in rung2_idx:
         s, ctx, lp = items[i]
         if plan is not None and plan.cpu_should_fail(ctx.label):
             diags[i] = (f"{diags[i]}; fault injection: CPU fallback "
                         "forced to fail")
+            if board is not None:
+                board.record("cpu_rung", False)
             continue
         if backend == "cpu" and statuses[i] == STATUS_PRIMAL_INFEASIBLE:
             continue      # HiGHS already certified it exactly
@@ -1808,6 +1851,8 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
                     s.health["watchdog_timeouts"] += 1
                 diags[i] = (f"{diags[i]}; watchdog: CPU fallback exceeded "
                             f"the {watchdog.deadline_s:g}s deadline")
+                if board is not None:
+                    board.record("cpu_rung", False)
                 continue
         if res.status == 0 and np.isfinite(res.obj):
             xr = np.array(res.x, dtype=float)
@@ -1820,20 +1865,31 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
                                         policy,
                                         was_rejected=(i in cert_rejected))
                     if policy.enabled else None)
+            if cert is not None and board is not None:
+                board.record("certify", cert.accepted)
             if cert is not None and not cert.accepted:
                 cert_rejected.add(i)
                 diags[i] = (f"{certify.REJECT_DIAG_PREFIX} CPU-fallback "
                             f"solution rejected: {cert.reason}")
+                if board is not None:
+                    board.record("cpu_rung", False)
                 continue
             xs[i], objs[i], ok[i] = xr, res.obj, True
             with _health_lock:
                 s.health["cpu_fallback"] += 1
+            if board is not None:
+                board.record("cpu_rung", True)
             TellUser.info(f"window {ctx.label} rescued on the exact CPU "
                           "fallback")
         elif statuses[i] != STATUS_PRIMAL_INFEASIBLE:
             # keep the richer dual-ray diagnosis when PDHG certified
             # infeasibility; otherwise HiGHS's verdict is the better one
             diags[i] = res.message or diags[i]
+            if board is not None:
+                # a definitive infeasible VERDICT is the exact rung doing
+                # its job (window-shaped failure, not rung sickness);
+                # only abnormal exits count against the rung's breaker
+                board.record("cpu_rung", res.status == 2)
     if ledger is not None and rung2_idx:
         ledger.append({"rung": "cpu_fallback", "backend": "cpu",
                        "batch": len(rung2_idx),
@@ -1963,7 +2019,8 @@ def summarize_solve_ledger(entries, dispatch_solve_s: float,
 
 def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
                  checkpoint_dir=None, supervisor=None,
-                 on_case_solved=None, solver_cache=None) -> None:
+                 on_case_solved=None, solver_cache=None,
+                 breaker_board=None) -> None:
     """Dispatch driver over one or many cases (VERDICT r2 #3/#7).
 
     Replaces the reference's serial sensitivity for-loop
@@ -1997,7 +2054,11 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
     batches: callers coalescing cases from many requests simply pass all
     their scenarios here and the structure-key grouping batches them
     across request boundaries exactly like sensitivity cases.  Default
-    (None) keeps today's per-dispatch cache."""
+    (None) keeps today's per-dispatch cache.
+
+    ``breaker_board`` (a ``utils.breaker.BreakerBoard``, service callers
+    only) gates the escalation ladder's rungs through circuit breakers —
+    see ``resolve_group``.  None (solo runs) means no breakers."""
     from ..utils.errors import PreemptedError
     from ..utils import supervisor as _sup
     watchdog = (supervisor.watchdog if supervisor is not None
@@ -2042,7 +2103,8 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
     try:
         _dispatch_phases(scenarios, backend, solver_opts, watchdog,
                          _batch_boundary, on_case_solved,
-                         solver_cache=solver_cache)
+                         solver_cache=solver_cache,
+                         breaker_board=breaker_board)
     except PreemptedError as e:
         # graceful shutdown: any batched-up checkpoint state is flushed
         # (only the degradation path batches writes, in strides of 8 —
@@ -2072,7 +2134,7 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
 
 def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
                      _batch_boundary, on_case_solved=None,
-                     solver_cache=None) -> None:
+                     solver_cache=None, breaker_board=None) -> None:
     """Phases 1 (structure-grouped) and 2 (degradation-stepped) of the
     batched dispatch; split out of ``run_dispatch`` so the preemption
     handler wraps exactly the interruptible region."""
@@ -2155,7 +2217,8 @@ def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
         t0 = time.perf_counter()
         out = items, resolve_group(items, backend, solver_opts,
                                    key=key, cache=cache, watchdog=watchdog,
-                                   staged=staged, ledger=ledger_entries)
+                                   staged=staged, ledger=ledger_entries,
+                                   board=breaker_board, policy=cert_policy)
         dt_ = time.perf_counter() - t0
         with phase_lock:
             phase_acc["solve_s"] += dt_
@@ -2302,7 +2365,9 @@ def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
             xs, objs, ok, diags = resolve_group(items, backend, solver_opts,
                                                 key=key, cache=cache,
                                                 watchdog=watchdog,
-                                                ledger=ledger_entries)
+                                                ledger=ledger_entries,
+                                                board=breaker_board,
+                                                policy=cert_policy)
             phase_acc["solve_s"] += time.perf_counter() - t0
             for (s, ctx, lp), x, o, k, dg in zip(items, xs, objs, ok, diags):
                 s.apply_subgroup([(ctx, lp)], [x], [o], [k], [dg], backend)
@@ -2322,6 +2387,11 @@ def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
     ledger["certification"] = certify.aggregate_certification(
         {i: getattr(s, "certification", None)
          for i, s in enumerate(scenarios)})
+    if breaker_board is not None:
+        # service resilience: the ladder breakers' post-dispatch states
+        # ride the ledger so a tripped rung is visible next to the rung
+        # entries it suppressed
+        ledger["breakers"] = breaker_board.snapshot()
     shadow_got = ledger["certification"]["shadow"]["n"]
     if shadow_got < shadow_expected:
         # a sampled window ended quarantined (or its shadow re-solve

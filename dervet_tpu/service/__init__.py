@@ -1,13 +1,18 @@
 """Serving layer: persistent ScenarioService with cross-request
-continuous batching (see server.py for the architecture notes)."""
+continuous batching (see server.py for the architecture notes) and the
+self-healing resilience layer (see resilience.py: circuit breakers,
+load shedding with degraded-fidelity answers, backend-loss recovery,
+poison-request quarantine, crash-safe serve journal)."""
 from .client import ScenarioClient
-from .queue import (AdmissionQueue, DeadlineExpiredError, QueueFullError,
-                    RequestFailedError, RequestPreemptedError,
-                    ServiceClosedError, ServiceError)
+from .journal import ServiceJournal
+from .queue import (AdmissionQueue, BreakerOpenError, DeadlineExpiredError,
+                    PoisonRequestError, QueueFullError, RequestFailedError,
+                    RequestPreemptedError, ServiceClosedError, ServiceError)
 from .server import ScenarioService, serve_main
 
 __all__ = [
-    "AdmissionQueue", "DeadlineExpiredError", "QueueFullError",
-    "RequestFailedError", "RequestPreemptedError", "ScenarioClient",
-    "ScenarioService", "ServiceClosedError", "ServiceError", "serve_main",
+    "AdmissionQueue", "BreakerOpenError", "DeadlineExpiredError",
+    "PoisonRequestError", "QueueFullError", "RequestFailedError",
+    "RequestPreemptedError", "ScenarioClient", "ScenarioService",
+    "ServiceClosedError", "ServiceError", "ServiceJournal", "serve_main",
 ]

@@ -27,8 +27,9 @@ from ..io.summary import run_health_report
 from ..ops.certify import aggregate_audits
 from ..results.result import Result
 from ..scenario.scenario import MicrogridScenario, run_dispatch
-from ..utils.errors import (AggregatedSolverError, PreemptedError,
-                            TellUser)
+from ..utils.errors import (AggregatedSolverError, PoisonRequestError,
+                            PreemptedError, TellUser)
+from . import resilience
 from .queue import (DeadlineExpiredError, QueuedRequest,
                     RequestFailedError, RequestPreemptedError,
                     ServiceError)
@@ -81,12 +82,21 @@ def slice_request_ledger(ledger: Optional[Dict], request_id: str,
 
 def build_request_result(req: QueuedRequest,
                          scenarios: Dict[object, MicrogridScenario],
-                         ledger: Optional[Dict]) -> Result:
+                         ledger: Optional[Dict],
+                         fidelity: str = resilience.FIDELITY_FULL,
+                         breakers: Optional[Dict] = None) -> Result:
     """Assemble one request's :class:`Result` from its solved scenarios —
     the same collection path as ``api.DERVET.solve``'s tail (results
     registry, run-health report, invariant audit, sensitivity summary),
     scoped to the request.  Raises :class:`RequestFailedError` when every
-    case quarantined."""
+    case quarantined.
+
+    ``fidelity`` marks the answer tier: a load-shed ``"degraded"``
+    screening answer carries the mark in the Result AND its run-health
+    report, plus a resubmit hint — and is never certificate-stamped
+    (certification is disabled for the degraded dispatch).  ``breakers``
+    (the service board's snapshot) rides the run-health report so a
+    request served during a tripped-breaker episode says so."""
     results = Result.initialize(req.cases)
     results.request_id = req.request_id
     report = run_health_report(
@@ -95,6 +105,15 @@ def build_request_result(req: QueuedRequest,
          if s.quarantine is not None},
         certification_by_case={key: getattr(s, "certification", None)
                                for key, s in scenarios.items()})
+    report["fidelity"] = fidelity
+    if breakers:
+        report["breakers"] = breakers
+    results.fidelity = fidelity
+    if fidelity == resilience.FIDELITY_DEGRADED:
+        results.resubmit_hint = (
+            "degraded-fidelity screening answer (service was shedding "
+            "load): no certificate was issued — resubmit with a higher "
+            "priority for a certified answer")
     results.run_health = report
     if all(s.quarantine is not None for s in scenarios.values()):
         raise RequestFailedError(
@@ -132,24 +151,47 @@ class BatchRound:
     def __init__(self, requests: List[QueuedRequest], *, backend: str,
                  solver_opts=None, solver_cache=None, supervisor=None,
                  checkpoint_dir=None, on_stats=None,
-                 gc_checkpoints: bool = True):
+                 gc_checkpoints: bool = True, board=None, recovery=None,
+                 poison_registry=None, degraded: bool = False):
         self.requests = requests
         self.backend = backend
         self.solver_opts = solver_opts
         self.solver_cache = solver_cache
         self.supervisor = supervisor
-        self.checkpoint_dir = checkpoint_dir
+        # degraded rounds get NO checkpoint namespace: a checkpoint only
+        # records case content (not solver fidelity), so a loose
+        # screening solution persisted here would be reloaded verbatim
+        # by a later CERTIFIED resume of the same request id and shipped
+        # with a certified stamp — the exact integrity hole the
+        # degraded tier must never open.  Screening solves are cheap;
+        # they replay from scratch instead of resuming.
+        self.checkpoint_dir = None if degraded else checkpoint_dir
         self.on_stats = on_stats
         # a persistent service must not grow one checkpoint set per
         # request served forever: a successfully DELIVERED request's
         # npz checkpoints + manifest slice are garbage-collected (their
         # resume value is spent); failed/preempted requests keep theirs
         self.gc_checkpoints = bool(gc_checkpoints)
+        # resilience layer (all optional — a bare BatchRound behaves
+        # exactly like the pre-resilience one):
+        # breaker board gating the escalation-ladder rungs + the round's
+        # certify-storm backend override
+        self.board = board
+        # backend-loss recovery policy (teardown/re-init/failover)
+        self.recovery = recovery
+        # two-strike poison-request registry for crash attribution
+        self.poison_registry = poison_registry
+        # degraded tier: loose-tolerance short-budget screening solve,
+        # certification off, results explicitly marked
+        self.degraded = bool(degraded)
         # per-request scenario maps, built in run(); round observables
         self.scenarios: Dict[str, Dict[object, MicrogridScenario]] = {}
         self.ledger: Optional[Dict] = None
         self.stats: Dict[str, object] = {}
         self.preempted = False
+        # what the round ACTUALLY dispatched on (breaker override /
+        # backend-loss failover may differ from the service backend)
+        self.backend_used = backend
         # requests answered during batch assembly (expired / duplicate
         # id / assembly failure) — kept so the service's request
         # accounting still covers them
@@ -239,25 +281,114 @@ class BatchRound:
                 pass    # bookkeeping must never break delivery
 
     # ------------------------------------------------------------------
+    def _opts(self):
+        """The round's solver options — the BOOST-style loose-tolerance
+        short-budget screening options when this is a degraded round."""
+        if not self.degraded:
+            return self.solver_opts
+        from ..ops.pdhg import PDHGOptions
+        return PDHGOptions.screening(self.solver_opts)
+
+    def _dispatch(self, all_scens, backend: str) -> None:
+        """One dispatch attempt.  Degraded rounds run with the float64
+        certification layer disabled — their screening solutions are
+        honest best-effort estimates, and a certificate would reject
+        every one and climb the full ladder, defeating the shed."""
+        import contextlib
+        ctx = (resilience.certification_disabled() if self.degraded
+               else contextlib.nullcontext())
+        with ctx:
+            run_dispatch(all_scens, backend=backend,
+                         solver_opts=self._opts(),
+                         checkpoint_dir=self.checkpoint_dir,
+                         supervisor=self.supervisor,
+                         solver_cache=self.solver_cache,
+                         breaker_board=self.board)
+
+    def _rebuild_scenarios(self) -> List[MicrogridScenario]:
+        """Fresh scenario objects for the live requests (a replay after
+        backend loss must not reuse state a dying dispatch half-mutated;
+        already-solved windows reload from their checkpoints)."""
+        self.scenarios = {}
+        return self._build_scenarios()
+
     def run(self) -> None:
         """Dispatch the round and deliver every request's future.
 
         Raises :class:`~dervet_tpu.utils.errors.PreemptedError` after
         answering the in-flight requests with
         :class:`RequestPreemptedError` (manifests flushed) — the server
-        loop treats that as the drain signal."""
+        loop treats that as the drain signal.
+
+        Failure handling beyond the PR-5 baseline: a dispatch crash
+        classified as BACKEND LOSS tears down and re-initializes the
+        backend, replays the round from checkpoints, and fails over to
+        the exact CPU backend after N consecutive re-init failures; any
+        other unexpected crash with a poison registry attached runs the
+        ISOLATION protocol — each request re-dispatched alone, crashes
+        attributed and struck, two strikes = typed PoisonRequestError +
+        fingerprint blocklist — so one poisonous request never takes its
+        co-batched innocents down with it."""
         t0 = time.monotonic()
+        backend = self.backend
+        if self.board is not None and backend != "cpu" and \
+                self.board.is_open("certify"):
+            # certification-rejection storm: the accelerated path's data
+            # handling is suspect — serve this round from the exact CPU
+            # solver (the healthy rung) until a probe heals the breaker
+            TellUser.warning(
+                "service: certify breaker OPEN — routing this round to "
+                "the exact CPU backend")
+            backend = "cpu"
+        self.backend_used = backend
         all_scens = self._build_scenarios()
         if not all_scens:
             self._finish_stats(all_scens, t0)
             self._emit_stats()
             return
         try:
-            run_dispatch(all_scens, backend=self.backend,
-                         solver_opts=self.solver_opts,
-                         checkpoint_dir=self.checkpoint_dir,
-                         supervisor=self.supervisor,
-                         solver_cache=self.solver_cache)
+            # replay loop: backend losses re-init + replay (bounded by
+            # the recovery policy's failover); other errors fall through
+            # to the except arms below on the LAST attempt
+            replays = 0
+            max_replays = 0
+            if self.recovery is not None:
+                self.recovery.begin_round()
+                max_replays = self.recovery.max_reinits + 2
+            while True:
+                try:
+                    self._dispatch(all_scens, backend)
+                    break
+                except Exception as e:
+                    if self.recovery is None or replays >= max_replays \
+                            or not resilience.is_backend_loss(e):
+                        raise
+                    replays += 1
+                    self.recovery.note_loss()
+                    TellUser.error(
+                        f"service: backend loss mid-round ({e}) — "
+                        "tearing down and re-initializing")
+                    reinited = False
+                    while not reinited and \
+                            not self.recovery.should_failover():
+                        reinited = self.recovery.reinit(self.solver_cache)
+                    if not reinited:
+                        if backend == self.recovery.failover_backend:
+                            raise   # already on the failover backend
+                        self.recovery.failovers += 1
+                        backend = self.recovery.failover_backend
+                        self.backend_used = backend
+                        TellUser.error(
+                            f"service: {self.recovery.max_reinits} "
+                            "consecutive re-init failures — failing this "
+                            f"round over to the {backend!r} backend")
+                    # fresh scenario objects; solved windows reload from
+                    # the PR-2 checkpoints, so replay work is bounded
+                    all_scens = self._rebuild_scenarios()
+                    if not all_scens:
+                        self._finish_stats(all_scens, t0)
+                        self._emit_stats()
+                        return
         except PreemptedError as e:
             # run_dispatch already flushed per-case checkpoints + the
             # shared sweep manifest; add the per-request slices, then
@@ -293,6 +424,15 @@ class BatchRound:
                      for key, s in scens.items()}))
             return
         except Exception as e:
+            if self.poison_registry is not None:
+                # unexpected crash with attribution machinery attached:
+                # run the isolation protocol — each request re-dispatched
+                # ALONE so innocents complete and the poisonous request
+                # is struck, quarantined, and blocklisted
+                self._finish_stats(all_scens, t0)
+                self._emit_stats()
+                self._isolate_poison(e, backend)
+                return
             # an unexpected dispatch error (device OOM, driver bug) must
             # still ANSWER every in-flight future — a leaked unresolved
             # future hangs its client forever — before propagating to
@@ -307,18 +447,117 @@ class BatchRound:
         self._finish_stats(all_scens, t0)
         self._emit_stats()
         for req in self.requests:
-            scens = self.scenarios[req.request_id]
-            try:
-                results = build_request_result(req, scens, self.ledger)
-                results.request_latency_s = time.monotonic() - req.t_submit
-                req.future.set_result(results)
-                self._gc_request_artifacts(req)
-            except Exception as e:      # post failure stays per-request
-                if not isinstance(e, RequestFailedError):
-                    TellUser.error(f"request {req.request_id}: result "
-                                   f"collection failed: {e}")
-                self._write_one_manifest(req)   # keep resume material
-                req.future.set_exception(e)
+            self._deliver(req, self.scenarios[req.request_id], self.ledger)
+
+    def _deliver(self, req: QueuedRequest, scens, ledger) -> None:
+        """Build and deliver one request's result (or its typed
+        failure), with the round's fidelity mark and breaker states."""
+        try:
+            results = build_request_result(
+                req, scens, ledger,
+                fidelity=(resilience.FIDELITY_DEGRADED if self.degraded
+                          else resilience.FIDELITY_FULL),
+                breakers=(self.board.snapshot()
+                          if self.board is not None else None))
+            results.request_latency_s = time.monotonic() - req.t_submit
+            req.future.set_result(results)
+            self._gc_request_artifacts(req)
+        except Exception as e:      # post failure stays per-request
+            if not isinstance(e, RequestFailedError):
+                TellUser.error(f"request {req.request_id}: result "
+                               f"collection failed: {e}")
+            self._write_one_manifest(req)   # keep resume material
+            req.future.set_exception(e)
+
+    # ------------------------------------------------------------------
+    # Poison-request isolation
+    # ------------------------------------------------------------------
+    def _isolate_poison(self, batch_exc: Exception, backend: str) -> None:
+        """Attribution protocol after an unexpected round crash: each
+        live request re-dispatches ALONE (fresh scenarios; solved windows
+        reload from checkpoints).  Innocent requests complete normally;
+        a request whose solo dispatch crashes is STRUCK in the registry
+        — at two strikes it is quarantined with a typed
+        :class:`PoisonRequestError` (diagnosis attached) and its
+        fingerprint blocklisted, so resubmission is rejected fast at
+        admission instead of re-crashing another co-batched round."""
+        registry = self.poison_registry
+        TellUser.error(
+            f"service: round with {len(self.requests)} request(s) "
+            f"crashed unexpectedly ({batch_exc}) — isolating: each "
+            "request re-dispatches alone for crash attribution")
+        for req in self.requests:
+            if req.future.done():
+                continue
+            fp = req.fingerprint or resilience.request_fingerprint(
+                req.cases)
+            delivered = False
+            while not delivered:
+                try:
+                    self._solo_dispatch(req, backend)
+                    delivered = True
+                except PreemptedError as pe:
+                    # drain signal mid-isolation: every still-unanswered
+                    # request (this one AND the not-yet-isolated rest)
+                    # gets the typed resumable answer before the signal
+                    # propagates — a leaked unresolved future hangs its
+                    # client forever
+                    self.preempted = True
+                    self._write_request_manifests()
+                    from ..utils.supervisor import manifest_path
+                    for r in self.requests:
+                        if not r.future.done():
+                            r.future.set_exception(RequestPreemptedError(
+                                f"request {r.request_id!r} preempted "
+                                f"during crash isolation ({pe}); "
+                                "resubmit with the same request id and "
+                                "checkpoint directory to resume",
+                                manifest_path=(
+                                    manifest_path(self.checkpoint_dir,
+                                                  r.request_id)
+                                    if self.checkpoint_dir else None)))
+                    raise
+                except AggregatedSolverError as e:
+                    # data-shaped total failure: the existing typed
+                    # answer, not a poison strike
+                    self._write_one_manifest(req)
+                    req.future.set_exception(RequestFailedError(
+                        {key: (s.quarantine or {}).get("reason")
+                         for key, s in
+                         self.scenarios[req.request_id].items()}))
+                    delivered = True
+                except Exception as e:
+                    diag = f"{type(e).__name__}: {e}"
+                    count = registry.strike(fp, req.request_id, diag)
+                    if count >= registry.threshold:
+                        req.future.set_exception(PoisonRequestError(
+                            f"request {req.request_id!r} crashed the "
+                            f"dispatch {count} times and is quarantined; "
+                            "its content fingerprint is blocklisted — "
+                            "fix the inputs before resubmitting",
+                            diagnosis=diag))
+                        delivered = True
+                    else:
+                        TellUser.warning(
+                            f"service: request {req.request_id!r} crashed "
+                            f"alone (strike {count}/{registry.threshold})"
+                            " — retrying once")
+
+    def _solo_dispatch(self, req: QueuedRequest, backend: str) -> None:
+        """Dispatch ONE request by itself and deliver its result.
+        Raises on crash (the caller attributes it)."""
+        scens: Dict[object, MicrogridScenario] = {}
+        for key, case in req.cases.items():
+            namespaced = dataclasses.replace(
+                case, case_id=f"{req.request_id}.{key}")
+            s = MicrogridScenario(namespaced)
+            s.request_id = req.request_id
+            scens[key] = s
+        self.scenarios[req.request_id] = scens
+        self._dispatch(list(scens.values()), backend)
+        ledger = next(iter(scens.values())).solve_metadata.get(
+            "solve_ledger")
+        self._deliver(req, scens, ledger)
 
     def _finish_stats(self, all_scens, t0) -> None:
         led = self.ledger or {}
@@ -326,6 +565,9 @@ class BatchRound:
                    if g.get("rung") in (None, "initial")]
         self.stats = {
             "round_s": time.monotonic() - t0,
+            "fidelity": (resilience.FIDELITY_DEGRADED if self.degraded
+                         else resilience.FIDELITY_FULL),
+            "backend_used": self.backend_used,
             "requests": len(self.requests),
             "cases": len(all_scens),
             "windows": sum(len(s.windows) for s in all_scens),

@@ -8,6 +8,7 @@ backpressure rejections and a blocking solve wrapper.
 """
 from __future__ import annotations
 
+import random
 import time
 from concurrent.futures import Future
 from typing import Optional
@@ -21,20 +22,36 @@ class ScenarioClient:
 
     ``submit`` honors the service's backpressure contract: a
     :class:`~dervet_tpu.service.queue.QueueFullError` carries a
-    ``retry_after_s`` hint, and the client sleeps it out and retries up
-    to ``max_retries`` times before surfacing the rejection — the
-    behavior every caller of a loaded service needs and nobody should
-    hand-roll."""
+    ``retry_after_s`` hint (derived from the service's observed drain
+    rate), and the client sleeps it out — CAPPED and JITTERED — and
+    retries up to ``max_retries`` times before surfacing the rejection.
+    The jitter (±25% around the hint) matters at fleet scale: a burst
+    of rejected clients all honoring the same hint verbatim would
+    re-arrive in one synchronized spike and re-overload the server they
+    just backed off from."""
 
     def __init__(self, service, max_retries: int = 3,
-                 backoff_cap_s: float = 30.0):
+                 backoff_cap_s: float = 30.0, jitter_frac: float = 0.25,
+                 jitter_seed: Optional[int] = None):
         self.service = service
         self.max_retries = int(max_retries)
         self.backoff_cap_s = float(backoff_cap_s)
+        self.jitter_frac = float(jitter_frac)
+        # seedable so drills/tests are deterministic
+        self._rng = random.Random(jitter_seed)
+
+    def _backoff_s(self, hint: float) -> float:
+        """Cap the server's hint, then jitter ±jitter_frac around it."""
+        wait = min(float(hint), self.backoff_cap_s)
+        if self.jitter_frac > 0:
+            wait *= 1.0 + self._rng.uniform(-self.jitter_frac,
+                                            self.jitter_frac)
+        return max(0.0, wait)
 
     def submit(self, cases, *, request_id=None, priority: int = 0,
                deadline_s: Optional[float] = None) -> Future:
-        """Admit with bounded retry-after backoff on queue-full."""
+        """Admit with bounded, jittered retry-after backoff on
+        queue-full."""
         attempt = 0
         while True:
             try:
@@ -45,7 +62,7 @@ class ScenarioClient:
                 attempt += 1
                 if attempt > self.max_retries:
                     raise
-                wait = min(e.retry_after_s, self.backoff_cap_s)
+                wait = self._backoff_s(e.retry_after_s)
                 TellUser.info(
                     f"client: queue full, retry {attempt}/"
                     f"{self.max_retries} in {wait:.2f}s")
@@ -54,5 +71,8 @@ class ScenarioClient:
     def solve(self, cases, *, timeout: Optional[float] = None,
               **kwargs):
         """Submit and block for the request's
-        :class:`~dervet_tpu.results.result.Result`."""
+        :class:`~dervet_tpu.results.result.Result`.  Check
+        ``result.fidelity`` — a ``"degraded"`` answer was load-shed to
+        the screening tier and should be resubmitted (see
+        ``result.resubmit_hint``) when a certified answer is needed."""
         return self.submit(cases, **kwargs).result(timeout=timeout)

@@ -22,6 +22,7 @@ other failure mode.
 """
 from __future__ import annotations
 
+import collections
 import heapq
 import itertools
 import threading
@@ -30,54 +31,13 @@ from concurrent.futures import Future
 from typing import Dict, List, Optional
 
 from ..utils import faultinject
-
-
-class ServiceError(Exception):
-    """Base of the scenario service's typed errors."""
-
-
-class QueueFullError(ServiceError):
-    """Admission rejected: the queue is at capacity (or the ``overload``
-    fault forced the rejection).  ``retry_after_s`` is the service's
-    resubmission hint — roughly one batch-round wall time."""
-
-    def __init__(self, msg: str, retry_after_s: float = 1.0):
-        super().__init__(msg)
-        self.retry_after_s = float(retry_after_s)
-
-
-class DeadlineExpiredError(ServiceError):
-    """The request's deadline passed before its batch was dispatched.
-    Expired requests are dropped at batch-assembly time, BEFORE any LP is
-    built — they never poison the batch they would have ridden."""
-
-
-class ServiceClosedError(ServiceError):
-    """Admission refused: the service is draining or closed."""
-
-
-class RequestPreemptedError(ServiceError):
-    """The service was preempted (SIGTERM drain) while this request was
-    in flight.  Per-case checkpoints and the request's namespaced
-    ``run_manifest.<rid>.json`` were flushed first — resubmitting the
-    same request id against the same checkpoint directory resumes
-    instead of restarting."""
-
-    def __init__(self, msg: str, manifest_path=None):
-        super().__init__(msg)
-        self.manifest_path = manifest_path
-
-
-class RequestFailedError(ServiceError):
-    """Every case of the request was quarantined by the failure-isolation
-    layer; ``failures`` maps case key -> diagnosis."""
-
-    def __init__(self, failures: Dict):
-        self.failures = dict(failures)
-        lines = [f"  case {k}: {r}" for k, r in self.failures.items()]
-        super().__init__(
-            f"all {len(self.failures)} case(s) of the request failed:\n"
-            + "\n".join(lines))
+# the typed-error family lives in utils.errors (one base, machine-
+# readable kind + retry_hint); re-exported here for the historical
+# service import path
+from ..utils.errors import (BreakerOpenError, DeadlineExpiredError,  # noqa: F401
+                            PoisonRequestError, QueueFullError,
+                            RequestFailedError, RequestPreemptedError,
+                            ServiceClosedError, ServiceError, TypedError)
 
 
 class QueuedRequest:
@@ -85,7 +45,7 @@ class QueuedRequest:
     and the future the result is delivered through."""
 
     __slots__ = ("request_id", "cases", "priority", "deadline", "future",
-                 "seq", "t_submit")
+                 "seq", "t_submit", "fingerprint")
 
     def __init__(self, request_id: str, cases: Dict, priority: int = 0,
                  deadline_s: Optional[float] = None, seq: int = 0):
@@ -97,6 +57,9 @@ class QueuedRequest:
         self.future: Future = Future()
         self.seq = seq
         self.t_submit = now
+        # content fingerprint (poison-quarantine registry key), set by
+        # the service at admission; None for direct queue users
+        self.fingerprint: Optional[str] = None
 
     def expired(self) -> bool:
         return self.deadline is not None and time.monotonic() > self.deadline
@@ -113,20 +76,51 @@ class AdmissionQueue:
     whole backpressure contract (callers retry or shed; the service's
     memory stays bounded)."""
 
-    def __init__(self, max_depth: int = 64):
+    def __init__(self, max_depth: int = 64,
+                 fairness_after_s: float = 30.0):
         self.max_depth = int(max_depth)
         self._cond = threading.Condition()
         self._heap: List[tuple] = []
         self._seq = itertools.count()
         self.closed = False
-        # the retry-after hint tracks the service's recent round wall
-        # time (updated by the server after every batch round)
+        # static retry-after fallback, used until round history exists
+        # (note_round) lets the hint track the OBSERVED drain rate
         self.retry_after_s = 1.0
+        # recent completed rounds: (requests served, round wall seconds)
+        # — the drain-rate sample the retry-after hint is derived from
+        self._rounds = collections.deque(maxlen=16)
+        # fairness floor: a request that has waited longer than this is
+        # served ahead of higher priorities — sustained high-priority
+        # load can delay low-priority work but never starve it
+        self.fairness_after_s = float(fairness_after_s)
         self.counters = {"admitted": 0, "rejected_full": 0,
                          "rejected_overload": 0, "rejected_closed": 0,
-                         "expired": 0}
+                         "expired": 0, "fairness_promotions": 0}
 
     # ------------------------------------------------------------------
+    def note_round(self, requests_served: int, round_s: float) -> None:
+        """Record one completed batch round — the drain-rate sample the
+        retry-after hint is computed from (called by the server)."""
+        if requests_served > 0 and round_s > 0:
+            with self._cond:
+                self._rounds.append((int(requests_served), float(round_s)))
+
+    def _retry_hint(self) -> float:
+        """Seconds a rejected caller should wait: queue depth divided by
+        the OBSERVED recent drain rate (requests/sec over the last few
+        rounds), so the hint tracks real service speed instead of a
+        constant.  Falls back to the static ``retry_after_s`` until any
+        round has completed.  Caller holds the lock."""
+        if not self._rounds:
+            return self.retry_after_s
+        served = sum(n for n, _ in self._rounds)
+        busy_s = sum(s for _, s in self._rounds)
+        rate = served / busy_s          # requests/sec while solving
+        # a full queue drains max_depth requests before a retried
+        # admission can land; +1 for the retry itself
+        hint = (len(self._heap) + 1) / rate
+        return float(min(600.0, max(0.05, hint)))
+
     def put(self, req: QueuedRequest) -> None:
         """Admit ``req`` or raise a typed rejection (never blocks)."""
         with self._cond:
@@ -137,18 +131,18 @@ class AdmissionQueue:
                     "is draining — no new admissions")
             if faultinject.maybe_overload():
                 self.counters["rejected_overload"] += 1
+                hint = self._retry_hint()
                 raise QueueFullError(
                     f"request {req.request_id!r} rejected: queue full "
                     "(overload fault injection); retry after "
-                    f"{self.retry_after_s:.2f}s",
-                    retry_after_s=self.retry_after_s)
+                    f"{hint:.2f}s", retry_after_s=hint)
             if len(self._heap) >= self.max_depth:
                 self.counters["rejected_full"] += 1
+                hint = self._retry_hint()
                 raise QueueFullError(
                     f"request {req.request_id!r} rejected: queue depth "
                     f"{len(self._heap)} at capacity {self.max_depth}; "
-                    f"retry after {self.retry_after_s:.2f}s",
-                    retry_after_s=self.retry_after_s)
+                    f"retry after {hint:.2f}s", retry_after_s=hint)
             req.seq = next(self._seq)
             heapq.heappush(self._heap, (-req.priority, req.seq, req))
             self.counters["admitted"] += 1
@@ -196,7 +190,33 @@ class AdmissionQueue:
                     if remaining <= 0:
                         break
                     self._cond.wait(remaining)
+            # fairness floor: requests waiting past fairness_after_s are
+            # served FIRST (oldest first), ahead of priority order — a
+            # sustained stream of high-priority work can delay
+            # low-priority requests but never starve them out entirely
+            now = time.monotonic()
+            max_prio = max((e[2].priority for e in self._heap), default=0)
+            starved = sorted(
+                (entry for entry in self._heap
+                 if now - entry[2].t_submit > self.fairness_after_s
+                 and entry[2].priority < max_prio),
+                key=lambda e: e[1])
             out: List[QueuedRequest] = []
+            for entry in starved:
+                if len(out) >= max_batch:
+                    break
+                self._heap.remove(entry)
+                req = entry[2]
+                if req.expired():
+                    self.counters["expired"] += 1
+                    req.future.set_exception(DeadlineExpiredError(
+                        f"request {req.request_id!r} expired in queue "
+                        "before dispatch"))
+                    continue
+                self.counters["fairness_promotions"] += 1
+                out.append(req)
+            if starved:
+                heapq.heapify(self._heap)
             while self._heap and len(out) < max_batch:
                 _, _, req = heapq.heappop(self._heap)
                 if req.expired():

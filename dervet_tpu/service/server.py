@@ -42,8 +42,11 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..scenario.scenario import SolverCache
-from ..utils.errors import PreemptedError, TellUser
+from ..utils.breaker import BreakerBoard
+from ..utils.errors import (BreakerOpenError, PoisonRequestError,
+                            PreemptedError, TellUser)
 from ..utils.supervisor import RunSupervisor
+from . import resilience
 from .batcher import BatchRound
 from .queue import (AdmissionQueue, QueuedRequest, QueueFullError,
                     ServiceClosedError, ServiceError)
@@ -66,7 +69,14 @@ class ScenarioService:
                  max_queue_depth: int = 64, max_wait_s: float = 0.25,
                  max_batch_requests: int = 32, checkpoint_dir=None,
                  max_cached_structures: int = 64,
-                 gc_checkpoints: bool = True):
+                 gc_checkpoints: bool = True,
+                 load_shedding: bool = True,
+                 shed_threshold_frac: float = 0.75,
+                 shed_sustain_rounds: int = 2,
+                 shed_priority_max: int = 0,
+                 breaker_opts: Optional[Dict] = None,
+                 backend_max_reinits: int = 2,
+                 fairness_after_s: float = 30.0):
         self.backend = backend
         self.solver_opts = solver_opts
         self.max_wait_s = float(max_wait_s)
@@ -77,12 +87,41 @@ class ScenarioService:
         # by default (unbounded disk otherwise); failed/preempted
         # requests always keep theirs for resume
         self.gc_checkpoints = bool(gc_checkpoints)
-        self.queue = AdmissionQueue(max_queue_depth)
+        self.queue = AdmissionQueue(max_queue_depth,
+                                    fairness_after_s=fairness_after_s)
         # the hot-service core: compiled solvers + preconditioning live
         # across rounds (see run_dispatch's solver_cache hook), and
         # pad_grid snaps every coalesced batch onto the pdhg compaction
         # bucket widths so varying request mixes reuse compiled shapes
         self.solver_cache = SolverCache(pad_grid=(backend != "cpu"))
+        # -- self-healing layer (see service/resilience.py) ------------
+        # circuit breakers around the escalation-ladder rungs, the
+        # certification path, and the backend as a whole; thresholds are
+        # overridable via breaker_opts (window/min_samples/
+        # failure_threshold/cooldown_s)
+        self.breakers = BreakerBoard(**(breaker_opts or {}))
+        # the backend breaker trips only on TOTAL round failures (post
+        # recovery+failover), so it needs consecutive hard evidence
+        self.breakers.configure(
+            "backend", min_samples=2, failure_threshold=1.0,
+            **{k: v for k, v in (breaker_opts or {}).items()
+               if k in ("window", "cooldown_s")})
+        # load shedding: sustained overload answers low-priority
+        # requests with an explicit degraded-fidelity screening solve
+        # instead of rejecting them (None = shedding disabled)
+        self.shedder = (resilience.LoadShedder(
+            threshold_frac=shed_threshold_frac,
+            sustain_rounds=shed_sustain_rounds,
+            shed_priority_max=shed_priority_max)
+            if load_shedding else None)
+        # the degraded tier gets its OWN compiled-solver cache: a
+        # screening solver (loose tolerance, short budget) must never be
+        # handed to a certified-tier round sharing the structure key
+        self.degraded_cache = SolverCache(pad_grid=(backend != "cpu"))
+        # backend-loss recovery policy + poison-request registry
+        self.recovery = resilience.BackendRecovery(
+            max_reinits=backend_max_reinits)
+        self.poison_registry = resilience.PoisonRegistry()
         # drain flag is set from signal context (on_stop must stay
         # lock-free); the queue is closed later, on a normal thread.
         # Handlers install only when the OWNER enters the supervisor
@@ -107,7 +146,7 @@ class ScenarioService:
                         "windows": 0, "device_groups": 0,
                         "cross_request_groups": 0, "batch_sum": 0.0,
                         "compile_events": 0, "round_s": 0.0,
-                        "preempted": 0}
+                        "preempted": 0, "degraded_rounds": 0}
         self._requests = {"completed": 0, "failed": 0}
         self.last_round_ledger: Optional[Dict] = None
         self.device_info: Optional[Dict] = None
@@ -131,6 +170,26 @@ class ScenarioService:
             cases = dict(enumerate(cases))
         if not cases:
             raise ValueError("a request needs at least one case")
+        if self.breakers.is_open("backend"):
+            # the service is alive but cannot currently solve (backend
+            # re-init AND the CPU failover both failed): fail fast with
+            # the probe schedule instead of queueing work that will die
+            raise BreakerOpenError(
+                "service backend breaker is open — recent rounds failed "
+                "even after re-init and CPU failover; retry after the "
+                "probe window",
+                probe_in_s=self.breakers.get("backend").probe_in_s())
+        # poison blocklist: a request whose content fingerprint crashed
+        # the dispatch twice is rejected in microseconds here, instead
+        # of re-crashing a round it would share with innocents
+        fingerprint = resilience.request_fingerprint(cases)
+        diagnosis = self.poison_registry.blocked(fingerprint)
+        if diagnosis is not None:
+            raise PoisonRequestError(
+                f"request {request_id!r} rejected: its content is "
+                "quarantined (crashed the dispatch "
+                f"{self.poison_registry.threshold} times) — fix the "
+                f"inputs before resubmitting", diagnosis=diagnosis)
         with self._seq_lock:
             if request_id is None:
                 self._seq += 1
@@ -148,6 +207,7 @@ class ScenarioService:
             self._active_ids.add(str(request_id))
         req = QueuedRequest(request_id, cases, priority=priority,
                             deadline_s=deadline_s)
+        req.fingerprint = fingerprint
         req.future.add_done_callback(
             lambda _f, rid=str(request_id): self._release_id(rid))
         try:
@@ -199,26 +259,89 @@ class ScenarioService:
 
     def run_once(self, block: bool = False,
                  timeout: Optional[float] = None) -> int:
-        """Run one batch round synchronously; returns the number of
+        """Run one batch cycle synchronously; returns the number of
         requests served.  The manual drive used by tests and by callers
-        embedding the service without the batcher thread."""
+        embedding the service without the batcher thread.
+
+        Under SUSTAINED overload (queue pressure / deadline misses for
+        ``shed_sustain_rounds`` consecutive cycles) the cycle splits in
+        two rounds: low-priority requests are answered by the DEGRADED
+        tier first (loose-tolerance short-budget screening solve, its
+        own solver cache, certification off, results explicitly marked)
+        and the rest get the normal certified round — explicit
+        degradation instead of rejection or silent death."""
         requests = self.queue.take(max_batch=self.max_batch_requests,
                                    max_wait_s=self.max_wait_s,
                                    block=block, timeout=timeout)
         if not requests:
             return 0
-        rnd = BatchRound(requests, backend=self.backend,
-                         solver_opts=self.solver_opts,
-                         solver_cache=self.solver_cache,
-                         supervisor=self.supervisor,
-                         checkpoint_dir=self.checkpoint_dir,
-                         on_stats=self._absorb_round_stats,
-                         gc_checkpoints=self.gc_checkpoints)
-        try:
-            rnd.run()
-        finally:
-            self._absorb_request_outcomes(rnd)
-        return len(rnd.requests)
+        shed = False
+        if self.shedder is not None:
+            depth_at_start = self.queue.depth() + len(requests)
+            shed = self.shedder.observe(depth_at_start,
+                                        self.queue.max_depth,
+                                        self.queue.counters["expired"])
+        if shed:
+            certified, degraded = self.shedder.partition(requests)
+            if degraded:
+                TellUser.warning(
+                    f"service: overload sustained — shedding "
+                    f"{len(degraded)} low-priority request(s) to the "
+                    "degraded screening tier "
+                    f"({len(certified)} stay certified)")
+        else:
+            certified, degraded = requests, []
+        served = 0
+        tiers = [(reqs, is_degraded)
+                 for reqs, is_degraded in ((degraded, True),
+                                           (certified, False)) if reqs]
+        for t_idx, (reqs, is_degraded) in enumerate(tiers):
+            rnd = BatchRound(
+                reqs, backend=self.backend,
+                solver_opts=self.solver_opts,
+                # the degraded tier's compiled screening solvers must
+                # never leak into a certified round (shared structure
+                # keys, different budgets) — separate cache
+                solver_cache=(self.degraded_cache if is_degraded
+                              else self.solver_cache),
+                supervisor=self.supervisor,
+                checkpoint_dir=self.checkpoint_dir,
+                on_stats=self._absorb_round_stats,
+                gc_checkpoints=self.gc_checkpoints,
+                board=self.breakers, recovery=self.recovery,
+                poison_registry=self.poison_registry,
+                degraded=is_degraded)
+            try:
+                rnd.run()
+            except BaseException as e:
+                # the raising round answered ITS OWN requests, but any
+                # LATER tier was already popped from the queue — its
+                # futures must be answered here or clients blocked on
+                # them hang forever (neither a round nor _fail_pending
+                # would ever see them again)
+                for later_reqs, _ in tiers[t_idx + 1:]:
+                    for req in later_reqs:
+                        if not req.future.done():
+                            req.future.set_exception(ServiceClosedError(
+                                f"request {req.request_id!r} not "
+                                "dispatched: an earlier round of this "
+                                f"batch cycle failed ({e}) — resubmit"))
+                            with self._metrics_lock:
+                                self._requests["failed"] += 1
+                if not isinstance(e, PreemptedError):
+                    # a round that died even after backend recovery +
+                    # failover + poison isolation: hard evidence against
+                    # the backend breaker (admissions fail fast when it
+                    # trips), then propagate for the loop to log
+                    self.breakers.record("backend", False)
+                self._absorb_request_outcomes(rnd)
+                raise
+            else:
+                if rnd.requests:
+                    self.breakers.record("backend", True)
+                self._absorb_request_outcomes(rnd)
+            served += len(rnd.requests)
+        return served
 
     def _absorb_round_stats(self, rnd: BatchRound) -> None:
         """Round-level bookkeeping, fired by the batcher BEFORE any
@@ -229,6 +352,8 @@ class ScenarioService:
             self._rounds["count"] += 1
             if rnd.preempted:
                 self._rounds["preempted"] += 1
+            if rnd.degraded:
+                self._rounds["degraded_rounds"] += 1
             for k in ("requests", "cases", "windows", "device_groups",
                       "cross_request_groups", "compile_events"):
                 self._rounds[k] += int(st.get(k, 0))
@@ -238,8 +363,10 @@ class ScenarioService:
         if rnd.ledger is not None:
             self.last_round_ledger = rnd.ledger
         if st.get("round_s"):
-            # the backpressure retry-after hint tracks real round walls
-            self.queue.retry_after_s = max(0.05, float(st["round_s"]))
+            # the backpressure retry-after hint derives from the
+            # OBSERVED drain rate: feed the queue this round's sample
+            self.queue.note_round(int(st.get("requests", 0)),
+                                  float(st["round_s"]))
         # bound the structure cache: a service fed unbounded distinct
         # structures must not grow device/host memory forever — clearing
         # trades a re-precondition for boundedness (same policy as the
@@ -357,6 +484,15 @@ class ScenarioService:
                         "started": self._started,
                         "draining": self._draining.is_set(),
                         "device": self.device_info},
+            # self-healing layer: breaker states, shed/degraded counts,
+            # backend-loss recovery counters, poison quarantine
+            "resilience": {
+                "breakers": self.breakers.snapshot(),
+                "load_shedding": (self.shedder.snapshot()
+                                  if self.shedder is not None else None),
+                "backend_recovery": self.recovery.snapshot(),
+                "poison_quarantine": self.poison_registry.snapshot(),
+            },
         }
 
 
@@ -412,6 +548,14 @@ def serve_main(argv=None) -> int:
     for d in (incoming, results_root, done_dir, failed_dir):
         d.mkdir(parents=True, exist_ok=True)
 
+    # crash-safe journal: every admission/completion is an fsync'd
+    # append, so a HARD kill (SIGKILL — no drain path) loses nothing:
+    # the restarted loop replays the journal, re-serves unanswered
+    # requests idempotently, and finishes interrupted file moves
+    from .journal import ServiceJournal
+    journal = ServiceJournal(spool / "service_journal.jsonl")
+    journal.recover_spool(incoming, done_dir, failed_dir)
+
     service = ScenarioService(
         backend=args.backend,
         max_queue_depth=args.max_queue_depth,
@@ -421,19 +565,44 @@ def serve_main(argv=None) -> int:
     service.start()
     pending: Dict[str, Future] = {}
 
+    def _error_payload(err: BaseException) -> dict:
+        """Uniform machine-readable error record (the typed-error
+        family's as_dict; non-typed errors get the same shape)."""
+        from ..utils.errors import TypedError
+        if isinstance(err, TypedError):
+            return err.as_dict()
+        return {"error": type(err).__name__, "kind": "error",
+                "message": str(err), "retry_hint": None}
+
     def _finish(path: Path, rid: str, fut: Future) -> None:
-        """Done-callback: persist the request's outputs (or its error)
-        and move the input file out of incoming/."""
+        """Done-callback: persist the request's outputs (or its error),
+        journal the outcome, then move the input file out of incoming/
+        — in THAT order, so a hard kill at any point either re-serves
+        idempotently or replays only the file move (see journal.py)."""
         try:
             err = fut.exception()
             if err is None:
-                fut.result().save_as_csv(results_root / rid)
+                res = fut.result()
+                res.save_as_csv(results_root / rid)
+                if res.fidelity != "certified":
+                    # the degraded-answer contract: the mark must be
+                    # visible in the spool output, not only in-process
+                    atomic_write(results_root / rid / "fidelity.json",
+                                 json.dumps({
+                                     "fidelity": res.fidelity,
+                                     "resubmit_hint": res.resubmit_hint,
+                                 }, indent=2))
+                journal.completed(rid)
                 path.replace(done_dir / path.name)
                 TellUser.info(f"serve: request {rid} done -> "
                               f"{results_root / rid}")
             else:
+                payload = _error_payload(err)
                 atomic_write(failed_dir / f"{path.name}.error.txt",
                              f"{type(err).__name__}: {err}\n")
+                atomic_write(failed_dir / f"{path.name}.error.json",
+                             json.dumps(payload, indent=2))
+                journal.failed(rid, payload)
                 path.replace(failed_dir / path.name)
                 TellUser.error(f"serve: request {rid} failed: {err}")
         except Exception as e:          # never kill the batcher thread
@@ -479,6 +648,7 @@ def serve_main(argv=None) -> int:
                                    f"{e}")
                     continue
                 pending[rid] = fut
+                journal.admitted(rid, path.name)
                 fut.add_done_callback(
                     lambda f, p=path, r=rid: _finish(p, r, f))
                 submitted_any = True
@@ -497,6 +667,7 @@ def serve_main(argv=None) -> int:
             if not submitted_any:
                 service.supervisor.wait_stop(args.poll_s)
         service.drain()
+    journal.close()
     metrics = service.metrics()
     atomic_write(spool / "service_metrics.json",
                  json.dumps(metrics, indent=2))

@@ -1,9 +1,37 @@
 """Logging + error types (reference surface: storagevet.ErrorHandling,
-re-exported exceptions used across dervet — SURVEY.md §2.8)."""
+re-exported exceptions used across dervet — SURVEY.md §2.8).
+
+The service-facing errors form ONE typed family rooted at
+:class:`TypedError`: every member carries a machine-readable ``kind``
+slug and a ``retry_hint`` (seconds to wait before a retry makes sense,
+or None when retrying as-is cannot help), and serializes uniformly via
+:meth:`TypedError.as_dict` — so spool result files, the service
+journal, and client-side handling all dispatch on the same two fields
+instead of parsing prose."""
 from __future__ import annotations
 
 import logging
 from pathlib import Path
+from typing import Dict, Optional
+
+
+class TypedError(Exception):
+    """Base of the machine-readable error family.
+
+    ``kind`` is a stable slug clients switch on; ``retry_hint`` is the
+    seconds-to-wait suggestion (None = resubmitting the identical
+    request cannot help — fix the input or wait for an operator)."""
+
+    kind: str = "error"
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.retry_hint: Optional[float] = None
+
+    def as_dict(self) -> Dict:
+        """Uniform serialized form for spool result files / journals."""
+        return {"error": type(self).__name__, "kind": self.kind,
+                "message": str(self), "retry_hint": self.retry_hint}
 
 
 class ModelParameterError(Exception):
@@ -47,6 +75,111 @@ class PreemptedError(Exception):
     ``checkpoint_dir`` resumes instead of restarting.  The CLI maps this
     to exit code ``supervisor.EXIT_PREEMPTED`` (75, EX_TEMPFAIL) so job
     schedulers can tell preemption from failure."""
+
+
+class DeviceLossError(RuntimeError):
+    """The accelerator backend died mid-dispatch (the injected analogue
+    of an ``XlaRuntimeError`` device loss).  A ``RuntimeError`` subclass
+    — NOT part of the typed client family — because it models the
+    runtime-layer crash the service's backend-loss recovery exists to
+    absorb: clients should never see it, they see either a recovered
+    result or a typed failure after recovery is exhausted."""
+
+
+# ---------------------------------------------------------------------------
+# Service typed-error family (kind + retry_hint; re-exported by
+# dervet_tpu.service.queue for the historical import path)
+# ---------------------------------------------------------------------------
+
+class ServiceError(TypedError):
+    """Base of the scenario service's typed errors."""
+
+    kind = "service"
+
+
+class QueueFullError(ServiceError):
+    """Admission rejected: the queue is at capacity (or the ``overload``
+    fault forced the rejection).  ``retry_after_s`` is the service's
+    resubmission hint, derived from the observed recent drain rate
+    (queue depth / requests-per-second served) when round history
+    exists, else the static default."""
+
+    kind = "queue_full"
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+        self.retry_hint = self.retry_after_s
+
+
+class DeadlineExpiredError(ServiceError):
+    """The request's deadline passed before its batch was dispatched.
+    Expired requests are dropped at batch-assembly time, BEFORE any LP is
+    built — they never poison the batch they would have ridden."""
+
+    kind = "deadline_expired"
+
+
+class ServiceClosedError(ServiceError):
+    """Admission refused: the service is draining or closed."""
+
+    kind = "service_closed"
+
+
+class RequestPreemptedError(ServiceError):
+    """The service was preempted (SIGTERM drain) while this request was
+    in flight.  Per-case checkpoints and the request's namespaced
+    ``run_manifest.<rid>.json`` were flushed first — resubmitting the
+    same request id against the same checkpoint directory resumes
+    instead of restarting."""
+
+    kind = "request_preempted"
+
+    def __init__(self, msg: str, manifest_path=None):
+        super().__init__(msg)
+        self.manifest_path = manifest_path
+        self.retry_hint = 0.0       # resubmission resumes immediately
+
+
+class RequestFailedError(ServiceError):
+    """Every case of the request was quarantined by the failure-isolation
+    layer; ``failures`` maps case key -> diagnosis."""
+
+    kind = "request_failed"
+
+    def __init__(self, failures: Dict):
+        self.failures = dict(failures)
+        lines = [f"  case {k}: {r}" for k, r in self.failures.items()]
+        super().__init__(
+            f"all {len(self.failures)} case(s) of the request failed:\n"
+            + "\n".join(lines))
+
+
+class PoisonRequestError(ServiceError):
+    """The request's cases crashed the dispatch twice: it is quarantined
+    and its fingerprint blocklisted, so resubmission is rejected fast at
+    admission instead of re-crashing a round it would share with
+    innocent requests.  ``diagnosis`` carries the crash that earned the
+    quarantine."""
+
+    kind = "poison_request"
+
+    def __init__(self, msg: str, diagnosis: Optional[str] = None):
+        super().__init__(msg)
+        self.diagnosis = diagnosis
+
+
+class BreakerOpenError(ServiceError):
+    """Admission refused: the service's backend circuit breaker is open
+    (backend re-initialization and the CPU failover both failed) — the
+    service is alive but cannot currently solve.  ``retry_hint`` is the
+    breaker's next half-open probe time."""
+
+    kind = "breaker_open"
+
+    def __init__(self, msg: str, probe_in_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_hint = probe_in_s
 
 
 class TariffError(Exception):

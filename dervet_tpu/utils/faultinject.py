@@ -65,6 +65,37 @@ Two activation paths:
                                          bounds it to the first 2
                                          admissions (then the queue
                                          behaves normally)
+      DERVET_TPU_FAULT_DEVICE_LOSS=1     raise a DeviceLossError (the
+                                         injected analogue of an
+                                         XlaRuntimeError device loss)
+                                         from inside the solve call —
+                                         exercises the service's
+                                         backend-loss recovery: teardown,
+                                         warmup_devices re-init, in-round
+                                         replay from checkpoints, CPU
+                                         failover.
+                                         DERVET_TPU_FAULT_DEVICE_LOSS_AFTER=2
+                                         arms it after 2 solve calls
+                                         complete (default 0: the first
+                                         call dies);
+                                         DERVET_TPU_FAULT_DEVICE_LOSS_N=3
+                                         fires 3 consecutive losses
+                                         (default 1) — drills N-failed-
+                                         re-inits -> CPU failover
+      DERVET_TPU_FAULT_POISON=rid.0      poison-REQUEST crash: dispatching
+                                         the targeted case raises an
+                                         injected crash EVERY time it is
+                                         attempted ('all' matches every
+                                         case) — unlike the NaN poison
+                                         above, which the input guards
+                                         absorb gracefully, this models a
+                                         request that keeps killing the
+                                         whole round it is co-batched
+                                         into; exercises the service's
+                                         poison-quarantine path
+                                         (isolation re-runs, two-strike
+                                         fingerprint blocklist, typed
+                                         PoisonRequestError)
 
 Faults are observational flips, input corruptions, delays, and signals
 only — the injector never touches solver internals, so the production
@@ -91,6 +122,16 @@ EVENT_SLOW = "slow_solve"  # solve call delayed (bounded)
 EVENT_PREEMPT = "preempt"  # self-delivered SIGTERM at a batch boundary
 EVENT_CORRUPT = "corrupt_solution"  # solution vector perturbed post-solve
 EVENT_OVERLOAD = "overload"         # service admission forced to reject
+EVENT_DEVICE_LOSS = "device_loss"   # backend death raised mid-solve
+EVENT_POISON_CASE = "poison_case"   # targeted case crashes its dispatch
+
+
+class InjectedCrashError(RuntimeError):
+    """The ``poison_case`` fault's crash: an arbitrary non-backend
+    runtime error raised from inside a targeted case's dispatch — the
+    shape of failure the service's poison-request quarantine attributes
+    and blocklists.  Deliberately NOT a DeviceLossError: backend-loss
+    recovery must not try to re-init the device over it."""
 
 
 def _norm(values) -> frozenset:
@@ -120,7 +161,11 @@ class FaultPlan:
                  preempt_after: Optional[int] = None,
                  corrupt: Iterable = (), corrupt_scale: float = 0.05,
                  overload: bool = False,
-                 overload_n: Optional[int] = None):
+                 overload_n: Optional[int] = None,
+                 device_loss: bool = False,
+                 device_loss_after: int = 0,
+                 device_loss_n: int = 1,
+                 crash_cases: Iterable = ()):
         self.nonconverge = _norm(nonconverge)
         self.rungs = _norm(rungs)
         self.poison_cases = _norm(poison_cases)
@@ -143,6 +188,20 @@ class FaultPlan:
         self.overload = bool(overload)
         self.overload_n = None if overload_n is None else int(overload_n)
         self._overload_fired = 0
+        # device_loss: kill the backend from inside a solve call —
+        # armed after `device_loss_after` solve calls complete, fires
+        # `device_loss_n` consecutive times (so N-failed-re-init ->
+        # CPU-failover ladders are drillable), then the backend "heals"
+        self.device_loss = bool(device_loss)
+        self.device_loss_after = int(device_loss_after)
+        self.device_loss_n = int(device_loss_n)
+        self._solve_calls = 0
+        self._device_loss_fired = 0
+        # crash_cases (the `poison_case` kind): dispatching a targeted
+        # case raises an InjectedCrashError EVERY attempt — a genuinely
+        # poisonous request keeps crashing on retry, which is exactly
+        # what the two-strike quarantine needs to observe
+        self.crash_cases = _norm(crash_cases)
         self._preempt_fired = False
         self.fired: List[Tuple[str, str]] = []   # (rung/event, label/case)
 
@@ -201,6 +260,25 @@ class FaultPlan:
         self.fired.append((EVENT_OVERLOAD, str(self._overload_fired)))
         return True
 
+    def device_loss_due(self) -> bool:
+        """Should THIS solve call die with a device loss?  Counts solve
+        calls; fires on calls ``after < n_calls <= after + n``."""
+        if not self.device_loss:
+            return False
+        self._solve_calls += 1
+        if self._solve_calls <= self.device_loss_after or \
+                self._device_loss_fired >= self.device_loss_n:
+            return False
+        self._device_loss_fired += 1
+        self.fired.append((EVENT_DEVICE_LOSS, str(self._solve_calls)))
+        return True
+
+    def should_crash(self, case_id) -> bool:
+        if _match(self.crash_cases, case_id):
+            self.fired.append((EVENT_POISON_CASE, str(case_id)))
+            return True
+        return False
+
     def preempt_due(self, batches_done: int) -> bool:
         if self.preempt_after is None or self._preempt_fired or \
                 batches_done < self.preempt_after:
@@ -223,7 +301,9 @@ _ENV_VARS = ("DERVET_TPU_FAULT_NONCONVERGE", "DERVET_TPU_FAULT_POISON_CASE",
              "DERVET_TPU_FAULT_SLOW", "DERVET_TPU_FAULT_SLOW_S",
              "DERVET_TPU_FAULT_PREEMPT_AFTER", "DERVET_TPU_FAULT_CORRUPT",
              "DERVET_TPU_FAULT_CORRUPT_SCALE", "DERVET_TPU_FAULT_OVERLOAD",
-             "DERVET_TPU_FAULT_OVERLOAD_N")
+             "DERVET_TPU_FAULT_OVERLOAD_N", "DERVET_TPU_FAULT_DEVICE_LOSS",
+             "DERVET_TPU_FAULT_DEVICE_LOSS_AFTER",
+             "DERVET_TPU_FAULT_DEVICE_LOSS_N", "DERVET_TPU_FAULT_POISON")
 _ENV_PLAN: Optional[FaultPlan] = None
 _ENV_SNAPSHOT: Optional[tuple] = None
 
@@ -238,7 +318,11 @@ def _plan_from_env() -> Optional[FaultPlan]:
     cr = os.environ.get("DERVET_TPU_FAULT_CORRUPT")
     ov = os.environ.get("DERVET_TPU_FAULT_OVERLOAD", "").strip().lower()
     ov_on = ov not in ("", "0", "false", "off")
-    if not (nc or pc or cf or hg or sl or pa or cr or ov_on):
+    dl = os.environ.get("DERVET_TPU_FAULT_DEVICE_LOSS", "").strip().lower()
+    dl_on = dl not in ("", "0", "false", "off")
+    crash = os.environ.get("DERVET_TPU_FAULT_POISON")
+    if not (nc or pc or cf or hg or sl or pa or cr or ov_on or dl_on
+            or crash):
         return None
     ov_n = os.environ.get("DERVET_TPU_FAULT_OVERLOAD_N")
     rungs = os.environ.get("DERVET_TPU_FAULT_RUNGS", RUNG_SOLVE)
@@ -254,7 +338,13 @@ def _plan_from_env() -> Optional[FaultPlan]:
         corrupt_scale=float(
             os.environ.get("DERVET_TPU_FAULT_CORRUPT_SCALE", 0.05)),
         overload=ov_on,
-        overload_n=int(ov_n) if ov_n else None)
+        overload_n=int(ov_n) if ov_n else None,
+        device_loss=dl_on,
+        device_loss_after=int(
+            os.environ.get("DERVET_TPU_FAULT_DEVICE_LOSS_AFTER", 0)),
+        device_loss_n=int(
+            os.environ.get("DERVET_TPU_FAULT_DEVICE_LOSS_N", 1)),
+        crash_cases=crash or ())
 
 
 def get_plan() -> Optional[FaultPlan]:
@@ -358,6 +448,31 @@ def maybe_overload() -> bool:
     actually saturating a queue."""
     plan = get_plan()
     return plan is not None and plan.overload_due()
+
+
+def maybe_device_loss() -> None:
+    """``device_loss`` injection point, called at the top of each solve
+    call: when due, raise the injected backend-death error — exactly
+    where a real XlaRuntimeError would surface — so the service's
+    teardown / warmup re-init / checkpoint replay / CPU failover chain
+    is exercised end to end."""
+    from .errors import DeviceLossError
+    plan = get_plan()
+    if plan is not None and plan.device_loss_due():
+        raise DeviceLossError(
+            "fault injection: device loss — backend died mid-solve")
+
+
+def maybe_crash_case(case_id) -> None:
+    """``poison_case`` injection point at the pre-dispatch boundary:
+    a targeted case raises an injected crash EVERY time its dispatch is
+    attempted (a genuinely poisonous request keeps crashing on retry) —
+    the service's isolation re-runs attribute it, and the two-strike
+    registry quarantines + blocklists its fingerprint."""
+    plan = get_plan()
+    if plan is not None and plan.should_crash(case_id):
+        raise InjectedCrashError(
+            f"fault injection: poison request crash (case {case_id})")
 
 
 def maybe_preempt(batches_done: int) -> bool:

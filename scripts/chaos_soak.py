@@ -1,0 +1,457 @@
+"""Chaos/soak harness: a seeded fault schedule against a LIVE service.
+
+Every resilience mechanism this repo has grown — escalation ladder,
+watchdog, certification, breakers, load shedding, backend-loss
+recovery, poison quarantine, journal recovery — is code that only runs
+when something is on fire.  This harness sets the fires on a SCHEDULE
+(seeded RNG, reproducible bit for bit) and asserts the service-level
+contract that CI can hold:
+
+* **zero lost requests** — every admitted future resolves, with a
+  result or a TYPED error; a raw leaked exception or an unresolved
+  future fails the soak;
+* **zero certified-wrong answers** — every ``fidelity: "certified"``
+  result carries a 100%-certified run-health report with no final
+  rejections; every degraded answer is explicitly marked and carries NO
+  certificate;
+* **bounded latency during degradation** — p99 over the soak stays
+  under a hard bound even through hang/overload/device-loss bursts;
+* **exit-0 recovery** — the service drains clean after the storm, and
+  a ``dervet-tpu serve`` loop SIGKILLED mid-flight (no drain path at
+  all) recovers every journaled spool request on restart with
+  byte-identical result CSVs.
+
+Phases:
+
+1. **soak** — ``--requests N`` requests pushed through an in-process
+   ``ScenarioService`` in seeded bursts; each burst draws a fault from
+   {none, overload+shed, hang, corrupt_solution, device_loss,
+   poison_case, deadline_expiry} through the fault-injection layer.
+2. **preempt** — SIGTERM mid-round: typed preemption answers, then a
+   fresh service with the same checkpoint dir + request ids resumes to
+   objectives identical to an uninterrupted run.
+3. **sigkill** (skippable: ``--skip-sigkill``) — a real ``serve``
+   subprocess is SIGKILLED mid-spool; the restarted ``--once`` loop
+   must journal-recover every request, byte-identical to an
+   uninterrupted reference serve.
+
+Usage (CI runs the first line)::
+
+    python scripts/chaos_soak.py --seed 0 --requests 200
+    python scripts/chaos_soak.py --serve-child SPOOL   # internal
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+# the chaos drills are cpu-backend by design (determinism is the whole
+# point); on TPU hosts the JAX_PLATFORMS env var is ignored because the
+# interpreter pre-imports jax, so force the platform the way
+# tests/conftest.py does
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# the soak drills the watchdog (hang bursts): a solve deadline must be
+# armed BEFORE any RunSupervisor (and its watchdog) is constructed.
+# 3 s clears every honest cpu-backend group solve by a wide margin.
+HANG_DEADLINE_S = 3.0
+HANG_SLEEP_S = 4.0
+
+FAULT_KINDS = ("none", "none", "none", "none", "none",
+               "overload", "hang", "corrupt", "device_loss",
+               "poison", "expiry")
+
+
+def _cases(n: int, months: int = 1, variant: int = 0):
+    """Synthetic request content.  ``variant`` nudges the battery energy
+    rating so every soak request has DISTINCT content — the poison
+    registry keys on content fingerprints, and identical content across
+    all requests would let one quarantine blocklist the whole soak.
+    (Bounds-only change: every variant still shares the compiled LP
+    structure, so the hot cache keeps working.)"""
+    from dervet_tpu.benchlib import synthetic_sensitivity_cases
+    cases = synthetic_sensitivity_cases(n, months=months)
+    for c in cases:
+        for tag, _, keys in c.ders:
+            if tag == "Battery":
+                keys["ene_max_rated"] = \
+                    float(keys["ene_max_rated"]) + 0.001 * variant
+    return {i: c for i, c in enumerate(cases)}
+
+
+def log(msg: str) -> None:
+    print(f"chaos: {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Phase 1: the seeded soak
+# ---------------------------------------------------------------------------
+
+def run_soak(seed: int, n_requests: int, months: int = 1,
+             p99_bound_s: float = 60.0) -> dict:
+    from dervet_tpu.service import (PoisonRequestError, QueueFullError,
+                                    ScenarioClient, ScenarioService)
+    from dervet_tpu.utils import faultinject
+    from dervet_tpu.utils.errors import TypedError
+
+    rng = random.Random(seed)
+    svc = ScenarioService(backend="cpu", max_wait_s=0.0,
+                          max_queue_depth=16, max_batch_requests=4,
+                          shed_threshold_frac=0.5, shed_sustain_rounds=1,
+                          fairness_after_s=20.0)
+    client = ScenarioClient(svc, max_retries=4, jitter_seed=seed)
+    futures = {}            # rid -> (future, t_submit)
+    outcomes = {"completed": 0, "degraded": 0, "rejected_typed": 0,
+                "failed_typed": 0}
+    fault_counts = {}
+    latencies = []
+    submitted = 0
+    burst_no = 0
+
+    def drain_rounds(budget: int = 64) -> None:
+        for _ in range(budget):
+            if svc.run_once() == 0 and svc.queue.depth() == 0:
+                break
+
+    while submitted < n_requests:
+        burst_no += 1
+        fault = rng.choice(FAULT_KINDS)
+        burst = min(1 + rng.randrange(3), n_requests - submitted)
+        fault_counts[fault] = fault_counts.get(fault, 0) + burst
+        rids = []
+        for _ in range(burst):
+            rid = f"s{submitted:05d}"
+            submitted += 1
+            rids.append(rid)
+
+        def submit(rid, **kw):
+            try:
+                fut = client.submit(
+                    _cases(1, months, variant=len(futures)),
+                    request_id=rid, **kw)
+                futures[rid] = (fut, time.monotonic())
+            except (QueueFullError, PoisonRequestError) as e:
+                # typed fast rejection IS an answered request
+                outcomes["rejected_typed"] += 1
+                futures[rid] = (e, time.monotonic())
+
+        if fault == "none":
+            for rid in rids:
+                submit(rid, priority=rng.randrange(3))
+            drain_rounds()
+        elif fault == "overload":
+            # flood past the shed threshold: low-priority requests get
+            # degraded screening answers, high-priority stay certified;
+            # a couple of injected queue-full rejections drill the
+            # client's capped+jittered retry discipline
+            with faultinject.inject(overload=True, overload_n=1):
+                for k, rid in enumerate(rids):
+                    submit(rid, priority=k % 2)
+            extra = [f"s{submitted + j:05d}x" for j in range(10)]
+            for k, rid in enumerate(extra):
+                submit(rid, priority=k % 2)
+            drain_rounds()
+        elif fault == "hang":
+            # one solve call sleeps past the watchdog deadline: the call
+            # is abandoned, counted, and the windows recover downstream
+            for rid in rids:
+                submit(rid)
+            with faultinject.inject(hang="all",
+                                    hang_seconds=HANG_SLEEP_S):
+                svc.run_once()
+            drain_rounds()
+        elif fault == "corrupt":
+            # solver says OPTIMAL, numbers are wrong: only the float64
+            # certifier can catch it; the ladder must recover and the
+            # final answer must still be 100% certified
+            for rid in rids:
+                submit(rid)
+            with faultinject.inject(corrupt="all", corrupt_scale=0.05):
+                svc.run_once()
+            drain_rounds()
+        elif fault == "device_loss":
+            for rid in rids:
+                submit(rid)
+            with faultinject.inject(device_loss=True,
+                                    device_loss_n=1):
+                drain_rounds()
+        elif fault == "poison":
+            # first request of the burst is poisonous: its dispatch
+            # crashes every attempt; co-batched innocents must complete
+            bad = rids[0]
+            for rid in rids:
+                submit(rid)
+            with faultinject.inject(crash_cases={f"{bad}.0"}):
+                drain_rounds()
+        elif fault == "expiry":
+            for rid in rids:
+                submit(rid, deadline_s=1e-9)
+            time.sleep(0.01)
+            drain_rounds()
+
+    drain_rounds(budget=256)
+
+    # ---- the contract ------------------------------------------------
+    lost = []
+    for rid, (fut_or_err, t0) in futures.items():
+        if not hasattr(fut_or_err, "done"):
+            continue                    # typed admission rejection
+        fut = fut_or_err
+        if not fut.done():
+            lost.append(rid)
+            continue
+        err = fut.exception()
+        if err is None:
+            res = fut.result()
+            latencies.append(res.request_latency_s or 0.0)
+            cert = res.run_health["certification"]
+            n_win = sum(len(inst.scenario.windows)
+                        for inst in res.instances.values())
+            if res.fidelity == "certified":
+                outcomes["completed"] += 1
+                assert cert["enabled"], f"{rid}: cert disabled on a " \
+                    "certified-fidelity result"
+                assert cert["windows_certified"] == n_win, \
+                    f"{rid}: {cert['windows_certified']}/{n_win} certified"
+                assert cert["windows"]["rejected_final"] == 0, \
+                    f"{rid}: final certificate rejections"
+            else:
+                outcomes["degraded"] += 1
+                assert res.fidelity == "degraded", res.fidelity
+                assert res.resubmit_hint, f"{rid}: degraded without hint"
+                assert res.run_health["fidelity"] == "degraded"
+                assert cert["windows_certified"] == 0, \
+                    f"{rid}: degraded answer carries certificates"
+        else:
+            assert isinstance(err, TypedError), \
+                f"{rid}: RAW error leaked to the client: {err!r}"
+            outcomes["failed_typed"] += 1
+    assert not lost, f"lost requests (unresolved futures): {lost}"
+
+    latencies.sort()
+    p99 = latencies[int(0.99 * (len(latencies) - 1))] if latencies else 0.0
+    assert p99 <= p99_bound_s, \
+        f"p99 {p99:.1f}s exceeds the {p99_bound_s:g}s degradation bound"
+
+    svc.drain()                         # exit-0 analogue: raises nothing
+    m = svc.metrics()
+    answered = sum(outcomes.values())
+    assert answered == len(futures), (answered, len(futures))
+    return {
+        "requests": len(futures),
+        "outcomes": outcomes,
+        "faults": fault_counts,
+        "latency_p50_s": round(latencies[len(latencies) // 2], 3)
+        if latencies else None,
+        "latency_p99_s": round(p99, 3),
+        "resilience": m["resilience"],
+        "queue": {k: m["queue"][k]
+                  for k in ("admitted", "rejected_full",
+                            "rejected_overload", "expired",
+                            "fairness_promotions")},
+    }
+
+
+# ---------------------------------------------------------------------------
+# Phase 2: preempt mid-round, typed answers, resume-identical
+# ---------------------------------------------------------------------------
+
+def run_preempt_drill(workdir: Path) -> dict:
+    from dervet_tpu.api import DERVET
+    from dervet_tpu.service import (RequestPreemptedError,
+                                    ScenarioService)
+    from dervet_tpu.utils import faultinject
+    from dervet_tpu.utils.errors import PreemptedError
+
+    ckpt = workdir / "preempt-ckpt"
+    ref = DERVET.from_cases(_cases(2, months=2)).solve(backend="cpu")
+
+    svc = ScenarioService(backend="cpu", max_wait_s=0.0,
+                          checkpoint_dir=ckpt)
+    fut = svc.submit(_cases(2, months=2), request_id="pre")
+    preempted = False
+    with svc.supervisor:
+        with faultinject.inject(preempt_after=1):
+            try:
+                svc.run_once()
+            except PreemptedError:
+                preempted = True
+    assert preempted, "preempt fault did not fire"
+    err = fut.exception(0)
+    assert isinstance(err, RequestPreemptedError), err
+
+    svc2 = ScenarioService(backend="cpu", max_wait_s=0.0,
+                           checkpoint_dir=ckpt)
+    fut2 = svc2.submit(_cases(2, months=2), request_id="pre")
+    assert svc2.run_once() == 1
+    res = fut2.result(0)
+    for k in ref.instances:
+        a = ref.instances[k].scenario.objective_values
+        b = res.instances[k].scenario.objective_values
+        assert a == b, f"resumed case {k} diverged from uninterrupted run"
+    svc2.close()
+    svc.close()
+    return {"preempted": True, "resumed_identical": True}
+
+
+# ---------------------------------------------------------------------------
+# Phase 3: SIGKILL a real serve loop, journal-recover byte-identical
+# ---------------------------------------------------------------------------
+
+N_SPOOL = 6
+
+
+def _spawn_serve(spool: Path, once: bool, slow: bool) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if slow:
+        # slow every solve so the SIGKILL reliably lands mid-spool
+        env.update(DERVET_TPU_FAULT_SLOW="all",
+                   DERVET_TPU_FAULT_SLOW_S="0.5")
+    cmd = [sys.executable, str(Path(__file__).resolve()),
+           "--serve-child", str(spool)]
+    if once:
+        cmd.append("--child-once")
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def serve_child(spool: str, once: bool) -> int:
+    """Internal: a real serve loop over synthetic inputs (model-params
+    parsing patched out — the chaos drill targets the SERVING machinery,
+    and the container has no reference data set)."""
+    from dervet_tpu.benchlib import synthetic_sensitivity_cases
+    from dervet_tpu.io import params as params_mod
+
+    def fake_initialize(cls, path, base_path=None, verbose=False):
+        return {0: synthetic_sensitivity_cases(1, months=1)[0]}
+
+    params_mod.Params.initialize = classmethod(fake_initialize)
+    from dervet_tpu.service.server import serve_main
+    argv = [str(spool), "--backend", "cpu", "--poll-s", "0.05"]
+    if once:
+        argv.append("--once")
+    return serve_main(argv)
+
+
+def run_sigkill_drill(workdir: Path) -> dict:
+    # reference: an uninterrupted --once serve of the same spool inputs
+    ref_spool = workdir / "ref-spool"
+    kill_spool = workdir / "kill-spool"
+    for spool in (ref_spool, kill_spool):
+        (spool / "incoming").mkdir(parents=True)
+        for i in range(N_SPOOL):
+            (spool / "incoming" / f"req{i}.csv").write_text("synthetic")
+    proc = _spawn_serve(ref_spool, once=True, slow=False)
+    assert proc.wait(timeout=600) == 0, "reference serve failed"
+
+    # kill run: serve loop (no --once), SIGKILL once the first request
+    # has fully landed in done/ — no drain path runs at all
+    proc = _spawn_serve(kill_spool, once=False, slow=True)
+    deadline = time.monotonic() + 300
+    try:
+        while not list((kill_spool / "done").glob("*.csv")):
+            assert proc.poll() is None, "serve child died early"
+            assert time.monotonic() < deadline, "no progress before kill"
+            time.sleep(0.05)
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=60)
+    killed_done = len(list((kill_spool / "done").glob("*.csv")))
+    log(f"sigkill: killed serve loop with {killed_done}/{N_SPOOL} "
+        "request(s) completed")
+
+    # restart: --once must journal-recover and serve EVERYTHING
+    proc = _spawn_serve(kill_spool, once=True, slow=False)
+    assert proc.wait(timeout=600) == 0, "restarted serve failed"
+
+    recovered = 0
+    for i in range(N_SPOOL):
+        rid = f"req{i}"
+        assert (kill_spool / "done" / f"{rid}.csv").exists(), \
+            f"{rid}: input file not retired after recovery"
+        ref_dir = ref_spool / "results" / rid
+        got_dir = kill_spool / "results" / rid
+        ref_csvs = sorted(p.name for p in ref_dir.glob("*.csv"))
+        got_csvs = sorted(p.name for p in got_dir.glob("*.csv"))
+        assert ref_csvs == got_csvs and ref_csvs, \
+            f"{rid}: result CSV set differs after recovery"
+        for name in ref_csvs:
+            assert (ref_dir / name).read_bytes() == \
+                (got_dir / name).read_bytes(), \
+                f"{rid}/{name}: recovered bytes differ from " \
+                "uninterrupted serve"
+        recovered += 1
+
+    from dervet_tpu.service import ServiceJournal
+    journal = ServiceJournal(kill_spool / "service_journal.jsonl")
+    unfinished = journal.unfinished()
+    journal.close()
+    assert not unfinished, f"journal still has unfinished: {unfinished}"
+    return {"killed_with_done": killed_done, "recovered": recovered,
+            "byte_identical": True}
+
+
+# ---------------------------------------------------------------------------
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="seeded chaos/soak drill for the scenario service")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--requests", type=int, default=200)
+    parser.add_argument("--months", type=int, default=1)
+    parser.add_argument("--skip-sigkill", action="store_true",
+                        help="skip the subprocess SIGKILL phase")
+    parser.add_argument("--skip-preempt", action="store_true")
+    parser.add_argument("--workdir", default=None,
+                        help="scratch dir (default: a fresh tempdir)")
+    parser.add_argument("--serve-child", default=None,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--child-once", action="store_true",
+                        help=argparse.SUPPRESS)
+    args = parser.parse_args()
+
+    if args.serve_child:
+        return serve_child(args.serve_child, args.child_once)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # arm the watchdog BEFORE any service/supervisor is built (the hang
+    # bursts rely on it); generous vs honest cpu group solves
+    os.environ[
+        "DERVET_TPU_SOLVE_DEADLINE_S"] = str(HANG_DEADLINE_S)
+
+    import tempfile
+    workdir = Path(args.workdir or tempfile.mkdtemp(prefix="chaos-soak-"))
+    workdir.mkdir(parents=True, exist_ok=True)
+
+    t0 = time.time()
+    report = {"seed": args.seed}
+    log(f"soak: {args.requests} seeded requests …")
+    report["soak"] = run_soak(args.seed, args.requests,
+                              months=args.months)
+    if not args.skip_preempt:
+        log("preempt drill …")
+        report["preempt"] = run_preempt_drill(workdir)
+    if not args.skip_sigkill:
+        log("sigkill drill …")
+        report["sigkill"] = run_sigkill_drill(workdir)
+    report["elapsed_s"] = round(time.time() - t0, 1)
+    report["ok"] = True
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

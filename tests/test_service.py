@@ -468,7 +468,11 @@ class TestDrainAndResume:
                                                           monkeypatch):
         """A dispatch crash that is neither preemption nor solver
         failure must still resolve every in-flight future — a leaked
-        unresolved future hangs its client forever."""
+        unresolved future hangs its client forever.  Since PR 6 the
+        poison-isolation protocol attributes the repeatable crash and
+        answers with the TYPED quarantine error (diagnosis attached)
+        instead of leaking the raw exception; the service survives."""
+        from dervet_tpu.service import PoisonRequestError
         from dervet_tpu.service import batcher as batcher_mod
 
         def boom(*a, **k):
@@ -477,9 +481,10 @@ class TestDrainAndResume:
         monkeypatch.setattr(batcher_mod, "run_dispatch", boom)
         svc = ScenarioService(backend="cpu", max_wait_s=0.0)
         fut = svc.submit(_cases(1), request_id="crashed")
-        with pytest.raises(RuntimeError, match="device fell over"):
-            svc.run_once()
-        assert isinstance(fut.exception(0), RuntimeError)
+        assert svc.run_once() == 1      # isolation handled: no raise
+        err = fut.exception(0)
+        assert isinstance(err, PoisonRequestError)
+        assert "device fell over" in err.diagnosis
         svc.close()
 
     def test_unsafe_request_id_rejected_at_admission(self):

@@ -1,0 +1,654 @@
+"""Self-healing scenario service: degradation & recovery drills.
+
+The resilience contract under test (PR 6):
+
+* **circuit breakers** — sliding-window failure rates trip a rung's
+  breaker; the escalation ladder skips the sick rung (serving from the
+  healthy ones), half-opens on a probe schedule, and the whole board is
+  visible in run_health, the solve ledger, and service metrics;
+* **load shedding** — sustained overload answers low-priority requests
+  with a loose-tolerance short-budget screening solve explicitly marked
+  ``fidelity: "degraded"`` and NEVER certificate-stamped; higher
+  priorities stay certified;
+* **backend-loss recovery** — a device death mid-round re-initializes
+  the backend and replays from checkpoints; N consecutive re-init
+  failures fail the round over to the exact CPU backend;
+* **poison quarantine** — a request that crashes the dispatch twice is
+  answered with a typed ``PoisonRequestError`` (diagnosis attached) and
+  its content fingerprint blocklisted, while co-batched innocents
+  complete undamaged;
+* **service journal** — the serve loop's append-only fsync'd journal
+  reconciles a SIGKILLed spool on restart.
+"""
+import json
+import time
+
+import pytest
+
+from dervet_tpu.benchlib import synthetic_sensitivity_cases
+from dervet_tpu.service import (PoisonRequestError, ScenarioClient,
+                                ScenarioService, ServiceJournal)
+from dervet_tpu.service.queue import QueuedRequest
+from dervet_tpu.service.resilience import (LoadShedder, PoisonRegistry,
+                                           is_backend_loss,
+                                           request_fingerprint)
+from dervet_tpu.utils import faultinject
+from dervet_tpu.utils.breaker import BreakerBoard, CircuitBreaker
+from dervet_tpu.utils.errors import (BreakerOpenError,
+                                     DeadlineExpiredError,
+                                     DeviceLossError, QueueFullError,
+                                     RequestFailedError, TypedError)
+
+
+def _cases(n_cases: int, months: int = 1, bump: float = 0.0):
+    cs = synthetic_sensitivity_cases(n_cases, months=months)
+    if bump:
+        # distinct content => distinct poison fingerprint
+        for c in cs:
+            for tag, _, keys in c.ders:
+                if tag == "Battery":
+                    keys["ene_max_rated"] = \
+                        float(keys["ene_max_rated"]) + bump
+    return {i: c for i, c in enumerate(cs)}
+
+
+# ---------------------------------------------------------------------------
+# Circuit breakers
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_trip_open_halfopen_close_cycle(self):
+        clock = [0.0]
+        br = CircuitBreaker("t", window=8, min_samples=3,
+                            failure_threshold=0.5, cooldown_s=10.0,
+                            clock=lambda: clock[0])
+        assert br.allow() and br.state == "closed"
+        br.record(True)
+        br.record(False)
+        br.record(False)                    # 2/3 failures >= 0.5: trip
+        assert br.state == "open" and br.trips == 1
+        assert not br.allow()
+        assert br.probe_in_s() == pytest.approx(10.0)
+        clock[0] = 10.5
+        assert br.allow()                   # half-open: one probe
+        assert not br.allow()               # probe in flight
+        br.record(False)                    # probe failed: re-open
+        assert br.state == "open"
+        clock[0] = 21.0
+        assert br.allow()
+        br.record(True)                     # probe ok: closed, fresh
+        assert br.state == "closed"
+        assert br.snapshot()["samples"] == 0
+
+    def test_lost_probe_reaped_not_wedged(self):
+        """A probe whose guarded path RAISES never reports an outcome;
+        after a cooldown of silence it is declared lost (a failure) and
+        the breaker re-opens — instead of wedging half-open-and-
+        refusing forever."""
+        clock = [0.0]
+        br = CircuitBreaker("t", min_samples=2, failure_threshold=1.0,
+                            cooldown_s=5.0, clock=lambda: clock[0])
+        br.record(False)
+        br.record(False)
+        clock[0] = 6.0
+        assert br.allow()                   # probe consumed…
+        # …and the path crashes: no record() ever arrives
+        clock[0] = 12.0
+        assert not br.allow()               # reaped -> OPEN, cooling
+        clock[0] = 18.0
+        assert br.allow()                   # a FRESH probe is possible
+        br.record(True)
+        assert br.state == "closed"
+
+    def test_record_only_caller_heals_after_cooldown(self):
+        """The service's backend breaker never calls allow(): the first
+        outcome recorded past the cooldown is treated as the probe."""
+        clock = [0.0]
+        br = CircuitBreaker("b", min_samples=2, failure_threshold=1.0,
+                            cooldown_s=5.0, clock=lambda: clock[0])
+        br.record(False)
+        br.record(False)
+        assert br.state == "open"
+        br.record(True)                     # inside cooldown: ignored
+        assert br.state == "open"
+        clock[0] = 6.0
+        br.record(True)                     # past cooldown: probe, heal
+        assert br.state == "closed"
+
+    def test_board_autocreate_and_snapshot(self):
+        board = BreakerBoard(min_samples=2, failure_threshold=1.0)
+        assert board.allow("anything")
+        board.record("anything", False)
+        board.record("anything", False)
+        assert board.is_open("anything")
+        snap = board.snapshot()
+        assert snap["anything"]["state"] == "open"
+
+    def test_retry_rung_breaker_skips_to_cpu_fallback(self):
+        """When the retry rung's failure rate trips its breaker, failed
+        windows skip the boosted retry and recover on the CPU rung —
+        and the breaker state is visible in ledger + run_health."""
+        svc = ScenarioService(
+            backend="cpu", max_wait_s=0.0,
+            breaker_opts={"min_samples": 2, "failure_threshold": 0.5,
+                          "cooldown_s": 300.0})
+        # nonconverge at solve AND retry rungs: every window fails rung
+        # 1, feeding the retry breaker failures until it trips
+        with faultinject.inject(nonconverge="all",
+                                rungs={"solve", "retry"}):
+            f1 = svc.submit(_cases(2), request_id="t1")
+            svc.run_once()
+            res1 = f1.result(0)
+            assert svc.breakers.get("retry_rung").state == "open"
+            # next round: retry rung skipped entirely, CPU rung recovers
+            fired_before = svc.breakers.get("retry_rung").snapshot()
+            f2 = svc.submit(_cases(1), request_id="t2")
+            svc.run_once()
+            res2 = f2.result(0)
+        assert res1.run_health["windows"]["cpu_fallback"] > 0
+        # round 2 recovered every window WITHOUT the retry rung: no new
+        # samples on the tripped breaker, all recoveries on cpu rung
+        assert res2.run_health["windows"]["cpu_fallback"] == \
+            sum(len(i.scenario.windows) for i in res2.instances.values())
+        assert res2.run_health["windows"]["retried"] == 0
+        assert svc.breakers.get("retry_rung").snapshot()["samples"] == \
+            fired_before["samples"]
+        # breaker states ride run_health and the round ledger
+        assert res2.run_health["breakers"]["retry_rung"]["state"] == \
+            "open"
+        assert svc.last_round_ledger["breakers"]["retry_rung"][
+            "state"] == "open"
+        svc.close()
+
+    def test_drain_while_breaker_open(self):
+        """Satellite drill: a drain with a tripped breaker must still
+        answer queued requests typed and exit clean."""
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0)
+        svc.breakers.configure("retry_rung", min_samples=1,
+                               failure_threshold=0.5, cooldown_s=300.0)
+        svc.breakers.record("retry_rung", False)
+        assert svc.breakers.is_open("retry_rung")
+        fut = svc.submit(_cases(1), request_id="queued")
+        svc.request_stop()
+        svc.drain()
+        from dervet_tpu.service import ServiceClosedError
+        assert isinstance(fut.exception(0), ServiceClosedError)
+        assert svc.metrics()["resilience"]["breakers"]["retry_rung"][
+            "state"] == "open"
+
+    def test_backend_breaker_rejects_admissions_typed(self):
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0)
+        svc.breakers.configure("backend", min_samples=1,
+                               failure_threshold=0.5, cooldown_s=300.0)
+        svc.breakers.record("backend", False)
+        with pytest.raises(BreakerOpenError) as ei:
+            svc.submit(_cases(1))
+        assert ei.value.kind == "breaker_open"
+        assert ei.value.retry_hint == pytest.approx(300.0, abs=5.0)
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Load shedding: the degraded-fidelity tier
+# ---------------------------------------------------------------------------
+
+class TestLoadShedding:
+    def _overloaded_service(self):
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0,
+                              max_queue_depth=8, max_batch_requests=4,
+                              shed_threshold_frac=0.5,
+                              shed_sustain_rounds=1)
+        futs = {}
+        for i in range(8):
+            futs[i] = svc.submit(_cases(1), request_id=f"r{i}",
+                                 priority=(1 if i % 2 else 0))
+        while svc.queue.depth():
+            svc.run_once()
+        return svc, futs
+
+    def test_low_priority_degraded_high_priority_certified(self):
+        svc, futs = self._overloaded_service()
+        for i, fut in futs.items():
+            res = fut.result(0)
+            if i % 2:
+                assert res.fidelity == "certified", i
+            else:
+                assert res.fidelity == "degraded", i
+        svc.close()
+
+    def test_degraded_marked_never_certified_stamped(self):
+        svc, futs = self._overloaded_service()
+        degraded = [f.result(0) for i, f in futs.items() if not i % 2]
+        assert degraded
+        for res in degraded:
+            cert = res.run_health["certification"]
+            assert not cert["enabled"]
+            assert cert["windows_certified"] == 0
+            assert res.run_health["fidelity"] == "degraded"
+            assert "resubmit" in res.resubmit_hint
+        # the certified tier in the SAME storm stays fully certified
+        certified = [f.result(0) for i, f in futs.items() if i % 2]
+        for res in certified:
+            cert = res.run_health["certification"]
+            assert cert["enabled"]
+            n_win = sum(len(inst.scenario.windows)
+                        for inst in res.instances.values())
+            assert cert["windows_certified"] == n_win
+        svc.close()
+
+    def test_shed_metrics_and_release(self):
+        svc, futs = self._overloaded_service()
+        shed = svc.metrics()["resilience"]["load_shedding"]
+        assert shed["degraded_requests"] >= 1
+        assert svc.metrics()["rounds"]["degraded_rounds"] >= 1
+        # pressure gone: the next request is served certified again
+        fut = svc.submit(_cases(1), request_id="calm", priority=0)
+        while not fut.done():
+            svc.run_once()
+        assert fut.result(0).fidelity == "certified"
+        svc.close()
+
+    def test_degraded_round_writes_no_checkpoints(self, tmp_path):
+        """A checkpoint records case content, not solver fidelity: a
+        screening solution persisted under the certified namespace
+        would be reloaded verbatim by a later certified resume of the
+        same request id.  Degraded rounds therefore get NO checkpoint
+        namespace at all."""
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0,
+                              max_queue_depth=4, max_batch_requests=2,
+                              shed_threshold_frac=0.5,
+                              shed_sustain_rounds=1,
+                              checkpoint_dir=tmp_path)
+        futs = [svc.submit(_cases(1, bump=0.001 * i),
+                           request_id=f"d{i}", priority=0)
+                for i in range(4)]
+        while any(not f.done() for f in futs):
+            svc.run_once()
+        degraded = [f.result(0) for f in futs
+                    if f.result(0).fidelity == "degraded"]
+        assert degraded                     # the drill actually shed
+        assert not list(tmp_path.glob("case*.npz"))
+        assert not list(tmp_path.glob("run_manifest*"))
+        svc.close()
+
+    def test_failed_first_round_answers_second_tier_typed(self,
+                                                          monkeypatch):
+        """When the degraded round dies hard, the certified tier taken
+        in the same cycle (already out of the queue) must still be
+        answered — not leaked as a forever-pending future."""
+        from dervet_tpu.service import ServiceClosedError
+        from dervet_tpu.service import batcher as batcher_mod
+
+        real_run = batcher_mod.BatchRound.run
+
+        def exploding_run(self):
+            if self.degraded:
+                raise RuntimeError("degraded round exploded")
+            return real_run(self)
+
+        monkeypatch.setattr(batcher_mod.BatchRound, "run", exploding_run)
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0,
+                              max_queue_depth=4, max_batch_requests=4,
+                              shed_threshold_frac=0.5,
+                              shed_sustain_rounds=1)
+        futs = {i: svc.submit(_cases(1, bump=0.001 * i),
+                              request_id=f"m{i}", priority=i % 2)
+                for i in range(4)}
+        with pytest.raises(RuntimeError, match="degraded round"):
+            # depth past threshold -> shed engaged -> degraded round
+            # (priority 0) raises before the certified round runs
+            svc.run_once()
+        # the CERTIFIED tier was popped from the queue but never
+        # dispatched: its futures must be answered typed, not leaked
+        # (the exploding patch bypasses the round's own answer-before-
+        # raise contract, so only the later tier is asserted here)
+        for i in (1, 3):
+            err = futs[i].exception(0)
+            assert isinstance(err, ServiceClosedError), (i, err)
+            assert "not dispatched" in str(err)
+
+    def test_shedder_requires_sustained_pressure(self):
+        sh = LoadShedder(threshold_frac=0.5, sustain_rounds=2)
+        assert not sh.observe(8, 8, 0)      # first pressured round
+        assert sh.observe(8, 8, 0)          # second: engaged
+        assert not sh.observe(0, 8, 0)      # released immediately
+        assert not sh.observe(8, 8, 0)      # needs sustain again
+
+    def test_screening_options_are_loose_and_bounded(self):
+        from dervet_tpu.ops.pdhg import PDHGOptions
+        opts = PDHGOptions.screening()
+        base = PDHGOptions()
+        assert opts.eps_rel > base.eps_rel
+        assert opts.max_iters < base.max_iters
+        assert opts.cpu_rescue_after is None
+
+
+# ---------------------------------------------------------------------------
+# Backend-loss recovery
+# ---------------------------------------------------------------------------
+
+class TestBackendLossRecovery:
+    def test_classification(self):
+        assert is_backend_loss(DeviceLossError("x"))
+        assert not is_backend_loss(RuntimeError("some bug"))
+        assert not is_backend_loss(ValueError("bad input"))
+
+    def test_device_loss_reinit_and_replay(self):
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0)
+        with faultinject.inject(device_loss=True, device_loss_n=1) as p:
+            fut = svc.submit(_cases(2), request_id="dl")
+            assert svc.run_once() == 1
+        assert [k for k, _ in p.fired].count(
+            faultinject.EVENT_DEVICE_LOSS) == 1
+        res = fut.result(0)
+        n_win = sum(len(i.scenario.windows)
+                    for i in res.instances.values())
+        assert res.run_health["windows"]["clean"] == n_win
+        rec = svc.metrics()["resilience"]["backend_recovery"]
+        assert rec["losses"] == 1 and rec["reinits"] == 1
+        assert rec["failovers"] == 0
+        svc.close()
+
+    def test_consecutive_reinit_failures_fail_over_to_cpu(self):
+        """3 consecutive device losses (solve + two re-init probes) on
+        the jax backend exhaust the re-init budget; the round fails
+        over to the exact CPU backend and still completes."""
+        svc = ScenarioService(backend="jax", max_wait_s=0.0,
+                              backend_max_reinits=2)
+        with faultinject.inject(device_loss=True, device_loss_n=3):
+            fut = svc.submit(_cases(2), request_id="fo")
+            assert svc.run_once() == 1
+        res = fut.result(0)
+        assert res.fidelity == "certified"
+        rec = svc.metrics()["resilience"]["backend_recovery"]
+        assert rec["failovers"] == 1
+        assert rec["reinit_failures"] == 2
+        svc.close()
+
+    def test_replay_reuses_checkpoints(self, tmp_path):
+        """The replay after a device loss reloads already-solved windows
+        from the PR-2 checkpoints instead of re-solving everything."""
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0,
+                              checkpoint_dir=tmp_path)
+        # fire the loss after 2 solve calls: the first groups' windows
+        # are checkpointed before the crash
+        with faultinject.inject(device_loss=True, device_loss_after=2,
+                                device_loss_n=1):
+            fut = svc.submit(_cases(1, months=3), request_id="ck")
+            assert svc.run_once() == 1
+        res = fut.result(0)
+        assert res.run_health["windows"]["clean"] == 3
+        meta = res.instances[0].scenario.solve_metadata
+        # the replayed dispatch solved FEWER windows than the case has:
+        # the checkpointed ones were reloaded, not re-dispatched
+        assert meta["batched_solves"] < 3
+        svc.close()
+
+    def test_env_knobs_parse(self, monkeypatch):
+        monkeypatch.setenv("DERVET_TPU_FAULT_DEVICE_LOSS", "1")
+        monkeypatch.setenv("DERVET_TPU_FAULT_DEVICE_LOSS_AFTER", "1")
+        monkeypatch.setenv("DERVET_TPU_FAULT_DEVICE_LOSS_N", "2")
+        plan = faultinject.get_plan()
+        assert plan is not None
+        assert not plan.device_loss_due()       # call 1: armed after 1
+        assert plan.device_loss_due()           # call 2 dies
+        assert plan.device_loss_due()           # call 3 dies (n=2)
+        assert not plan.device_loss_due()       # spent
+
+    def test_exit_zero_recovery_via_serve_drain(self):
+        """Acceptance shape: a service that lost its backend mid-round
+        still drains clean (the exit-0 contract)."""
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0)
+        with faultinject.inject(device_loss=True, device_loss_n=1):
+            fut = svc.submit(_cases(1), request_id="x")
+            svc.run_once()
+        assert fut.result(0) is not None
+        svc.drain()                              # raises nothing
+        assert svc.metrics()["service"]["draining"]
+
+
+# ---------------------------------------------------------------------------
+# Poison-request quarantine
+# ---------------------------------------------------------------------------
+
+class TestPoisonQuarantine:
+    def test_two_strikes_typed_error_and_no_collateral_damage(self):
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0)
+        with faultinject.inject(crash_cases={"bad.0"}):
+            f_bad = svc.submit(_cases(1), request_id="bad")
+            f_ok = svc.submit(_cases(2, bump=7.0), request_id="ok")
+            assert svc.run_once() == 2
+        err = f_bad.exception(0)
+        assert isinstance(err, PoisonRequestError)
+        assert err.kind == "poison_request"
+        assert "poison request crash" in err.diagnosis
+        # co-batched innocents complete clean — no collateral damage
+        res = f_ok.result(0)
+        assert res.run_health["windows"]["quarantined"] == 0
+        assert sorted(res.instances) == [0, 1]
+        assert svc.metrics()["resilience"]["poison_quarantine"][
+            "quarantined"] == 1
+        svc.close()
+
+    def test_blocklisted_resubmission_rejected_fast_at_admission(self):
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0)
+        with faultinject.inject(crash_cases={"bad.0"}):
+            f_bad = svc.submit(_cases(1), request_id="bad")
+            svc.run_once()
+        assert isinstance(f_bad.exception(0), PoisonRequestError)
+        # identical content, new id, NO fault active: rejected at the
+        # admission boundary in microseconds, never dispatched
+        t0 = time.monotonic()
+        with pytest.raises(PoisonRequestError) as ei:
+            svc.submit(_cases(1), request_id="bad-again")
+        assert time.monotonic() - t0 < 1.0
+        assert ei.value.diagnosis
+        # DIFFERENT content sails through
+        fut = svc.submit(_cases(1, bump=3.0), request_id="fine")
+        assert svc.run_once() == 1
+        assert fut.result(0) is not None
+        svc.close()
+
+    def test_registry_two_strike_threshold(self):
+        reg = PoisonRegistry(threshold=2)
+        fp = "f" * 64
+        assert reg.blocked(fp) is None
+        assert reg.strike(fp, "r1", "boom") == 1
+        assert reg.blocked(fp) is None          # one strike: not yet
+        assert reg.strike(fp, "r2", "boom again") == 2
+        assert reg.blocked(fp) == "boom again"
+        assert reg.snapshot()["quarantined"] == 1
+
+    def test_fingerprint_tracks_content_not_request_id(self):
+        a1 = _cases(1)
+        a2 = _cases(1)
+        b = _cases(1, bump=1.0)
+        assert request_fingerprint(a1) == request_fingerprint(a2)
+        assert request_fingerprint(a1) != request_fingerprint(b)
+
+    def test_isolation_crash_answers_futures_typed(self, monkeypatch):
+        """Any repeatable unexpected round crash resolves every future
+        with a TYPED error (no raw leak, no hang) and quarantines the
+        crashing content after two strikes."""
+        from dervet_tpu.service import batcher as batcher_mod
+
+        def boom(*a, **k):
+            raise RuntimeError("device fell over")
+
+        monkeypatch.setattr(batcher_mod, "run_dispatch", boom)
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0)
+        fut = svc.submit(_cases(1), request_id="crashed")
+        assert svc.run_once() == 1
+        err = fut.exception(0)
+        assert isinstance(err, PoisonRequestError)
+        assert "device fell over" in err.diagnosis
+        svc.close()
+
+    def test_env_knob_parses(self, monkeypatch):
+        monkeypatch.setenv("DERVET_TPU_FAULT_POISON", "case7")
+        plan = faultinject.get_plan()
+        assert plan is not None
+        assert plan.should_crash("case7")
+        assert not plan.should_crash("case8")
+
+
+# ---------------------------------------------------------------------------
+# Typed-error family (satellite)
+# ---------------------------------------------------------------------------
+
+class TestTypedErrorFamily:
+    def test_kinds_and_uniform_serialization(self):
+        from dervet_tpu.utils.errors import (RequestPreemptedError,
+                                             ServiceClosedError)
+        samples = [
+            (QueueFullError("q", retry_after_s=2.5), "queue_full", 2.5),
+            (DeadlineExpiredError("d"), "deadline_expired", None),
+            (ServiceClosedError("c"), "service_closed", None),
+            (RequestFailedError({"a": "why"}), "request_failed", None),
+            (PoisonRequestError("p", diagnosis="d"), "poison_request",
+             None),
+            (BreakerOpenError("b", probe_in_s=7.0), "breaker_open", 7.0),
+            (RequestPreemptedError("r"), "request_preempted", 0.0),
+        ]
+        kinds = set()
+        for err, kind, hint in samples:
+            assert isinstance(err, TypedError)
+            assert err.kind == kind
+            assert err.retry_hint == hint
+            d = err.as_dict()
+            assert set(d) == {"error", "kind", "message", "retry_hint"}
+            assert d["kind"] == kind
+            kinds.add(kind)
+        assert len(kinds) == len(samples)   # kinds are distinct
+
+    def test_historical_import_path_still_works(self):
+        from dervet_tpu.service.queue import (  # noqa: F401
+            QueueFullError as Q, ServiceError)
+        assert issubclass(Q, ServiceError)
+
+
+# ---------------------------------------------------------------------------
+# Queue: drain-rate hint, fairness floor, deadline race (satellites)
+# ---------------------------------------------------------------------------
+
+class TestQueueSatellites:
+    def test_retry_hint_tracks_observed_drain_rate(self):
+        from dervet_tpu.service import AdmissionQueue
+        q = AdmissionQueue(max_depth=2)
+        q.retry_after_s = 9.9               # static fallback
+        q.put(QueuedRequest("a", {0: None}))
+        q.put(QueuedRequest("b", {0: None}))
+        with pytest.raises(QueueFullError) as e0:
+            q.put(QueuedRequest("c", {0: None}))
+        assert e0.value.retry_after_s == 9.9    # no history yet
+        # observed drain: 4 requests per second of round wall
+        q.note_round(requests_served=8, round_s=2.0)
+        with pytest.raises(QueueFullError) as e1:
+            q.put(QueuedRequest("d", {0: None}))
+        # depth 2 + the retry itself, at 4 req/s -> 0.75 s
+        assert e1.value.retry_after_s == pytest.approx(0.75)
+
+    def test_fairness_floor_prevents_priority_starvation(self):
+        from dervet_tpu.service import AdmissionQueue
+        q = AdmissionQueue(max_depth=64, fairness_after_s=0.05)
+        q.put(QueuedRequest("starved", {0: None}, priority=0))
+        time.sleep(0.06)
+        for i in range(6):                  # sustained hi-pri load
+            q.put(QueuedRequest(f"hi{i}", {0: None}, priority=9))
+        got = [r.request_id for r in q.take(max_batch=2, block=False)]
+        # the starved low-priority request is served FIRST, ahead of
+        # the high-priority stream, once past the fairness threshold
+        assert got[0] == "starved"
+        assert q.counters["fairness_promotions"] == 1
+
+    def test_fairness_floor_off_within_threshold(self):
+        from dervet_tpu.service import AdmissionQueue
+        q = AdmissionQueue(max_depth=64, fairness_after_s=30.0)
+        q.put(QueuedRequest("low", {0: None}, priority=0))
+        q.put(QueuedRequest("hi", {0: None}, priority=9))
+        got = [r.request_id for r in q.take(max_batch=2, block=False)]
+        assert got == ["hi", "low"]
+
+    def test_deadline_expiry_racing_batch_assembly(self):
+        """A request that expires AFTER take() but BEFORE its scenarios
+        assemble is answered typed at assembly time and never rides the
+        batch."""
+        from dervet_tpu.service.batcher import BatchRound
+        dead = QueuedRequest("race", _cases(1), deadline_s=0.02)
+        live = QueuedRequest("live", _cases(1))
+        time.sleep(0.03)                     # expires post-take
+        rnd = BatchRound([dead, live], backend="cpu")
+        rnd.run()
+        assert isinstance(dead.future.exception(0), DeadlineExpiredError)
+        assert live.future.result(0) is not None
+        assert dead in rnd.answered_early
+
+    def test_client_backoff_capped_and_jittered(self):
+        class _Svc:
+            pass
+        client = ScenarioClient(_Svc(), backoff_cap_s=2.0,
+                                jitter_frac=0.25, jitter_seed=7)
+        waits = {client._backoff_s(100.0) for _ in range(16)}
+        assert all(1.5 <= w <= 2.5 for w in waits)   # capped ±25%
+        assert len(waits) > 1                        # jittered
+
+    def test_client_jitter_deterministic_with_seed(self):
+        class _Svc:
+            pass
+        a = ScenarioClient(_Svc(), jitter_seed=3)
+        b = ScenarioClient(_Svc(), jitter_seed=3)
+        assert [a._backoff_s(1.0) for _ in range(5)] == \
+            [b._backoff_s(1.0) for _ in range(5)]
+
+
+# ---------------------------------------------------------------------------
+# Service journal
+# ---------------------------------------------------------------------------
+
+class TestServiceJournal:
+    def test_admitted_completed_replay(self, tmp_path):
+        j = ServiceJournal(tmp_path / "j.jsonl")
+        j.admitted("a", file="a.csv")
+        j.admitted("b", file="b.csv")
+        j.completed("a")
+        j.failed("c", error={"kind": "request_failed"})
+        assert j.replay()["a"]["state"] == "completed"
+        assert j.unfinished() == [("b", "b.csv")]
+        j.close()
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        path = tmp_path / "j.jsonl"
+        j = ServiceJournal(path)
+        j.admitted("a", file="a.csv")
+        j.close()
+        with open(path, "a") as fh:         # simulate SIGKILL mid-append
+            fh.write('{"event": "comple')
+        j2 = ServiceJournal(path)
+        assert j2.unfinished() == [("a", "a.csv")]
+        j2.close()
+
+    def test_recover_spool_moves_completed_reserves_admitted(
+            self, tmp_path):
+        incoming = tmp_path / "incoming"
+        done = tmp_path / "done"
+        failed = tmp_path / "failed"
+        for d in (incoming, done, failed):
+            d.mkdir()
+        (incoming / "x.csv").write_text("x")
+        (incoming / "y.csv").write_text("y")
+        (incoming / "z.csv").write_text("z")
+        j = ServiceJournal(tmp_path / "j.jsonl")
+        j.admitted("x", file="x.csv")
+        j.admitted("y", file="y.csv")
+        j.admitted("z", file="z.csv")
+        j.completed("x")                    # killed before the move
+        j.failed("z", error={"kind": "request_failed"})
+        rec = j.recover_spool(incoming, done, failed)
+        assert rec["reserve"] == ["y"]
+        assert sorted(rec["moved"]) == ["x", "z"]
+        assert (done / "x.csv").exists()
+        assert (incoming / "y.csv").exists()
+        # a journaled FAILURE is finished into failed/, never done/
+        assert (failed / "z.csv").exists()
+        assert not (done / "z.csv").exists()
+        j.close()
