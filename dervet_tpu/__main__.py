@@ -1,6 +1,8 @@
 """``python -m dervet_tpu`` / ``dervet-tpu`` console entry (mirrors
 reference run_DERVET.py:73-92).  ``dervet-tpu serve SPOOL_DIR`` starts
-the persistent scenario service instead (service.server.serve_main)."""
+the persistent scenario service instead (service.server.serve_main);
+``dervet-tpu design CASE --bounds ...`` runs a one-shot BOOST sizing
+frontier (design.cli.design_main)."""
 from __future__ import annotations
 
 import argparse
@@ -14,6 +16,12 @@ def main(argv=None):
         # batching, SIGTERM drain with exit 0 — its own argparse surface
         from .service.server import serve_main
         raise SystemExit(serve_main(argv[1:]))
+    if argv and argv[0] == "design":
+        # one-shot BOOST sizing: screen a candidate population, certify
+        # the top-k, write the ranked frontier — exit codes match solve
+        # (0 ok, 75 preempted)
+        from .design.cli import design_main
+        raise SystemExit(design_main(argv[1:]))
 
     from .api import DERVET
 

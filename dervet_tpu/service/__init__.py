@@ -1,8 +1,11 @@
 """Serving layer: persistent ScenarioService with cross-request
-continuous batching (see server.py for the architecture notes) and the
+continuous batching (see server.py for the architecture notes), the
 self-healing resilience layer (see resilience.py: circuit breakers,
 load shedding with degraded-fidelity answers, backend-loss recovery,
-poison-request quarantine, crash-safe serve journal)."""
+poison-request quarantine, crash-safe serve journal), and the BOOST
+design request type (``submit_design`` — ordinal screening + certified
+frontier; engine in ``dervet_tpu.design``, integration in
+``design.service``)."""
 from .client import ScenarioClient
 from .journal import ServiceJournal
 from .queue import (AdmissionQueue, BreakerOpenError, DeadlineExpiredError,

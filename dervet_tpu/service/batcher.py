@@ -451,8 +451,25 @@ class BatchRound:
 
     def _deliver(self, req: QueuedRequest, scens, ledger) -> None:
         """Build and deliver one request's result (or its typed
-        failure), with the round's fidelity mark and breaker states."""
+        failure), with the round's fidelity mark and breaker states.
+
+        Design requests deliver a
+        :class:`~dervet_tpu.design.frontier.DesignFrontier` instead of a
+        scenario :class:`Result`: their ``scens`` are the screened
+        finalists' certified solves, and the screening state carried on
+        the request supplies the population surface and ordinal ranks."""
         try:
+            if req.kind == "design" and req.design_state is not None:
+                from ..design.service import finalize_service_request
+                frontier = finalize_service_request(
+                    req, scens, ledger,
+                    breakers=(self.board.snapshot()
+                              if self.board is not None else None))
+                frontier.request_latency_s = \
+                    time.monotonic() - req.t_submit
+                req.future.set_result(frontier)
+                self._gc_request_artifacts(req)
+                return
             results = build_request_result(
                 req, scens, ledger,
                 fidelity=(resilience.FIDELITY_DEGRADED if self.degraded

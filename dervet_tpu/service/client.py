@@ -76,3 +76,34 @@ class ScenarioClient:
         the screening tier and should be resubmitted (see
         ``result.resubmit_hint``) when a certified answer is needed."""
         return self.submit(cases, **kwargs).result(timeout=timeout)
+
+    def submit_design(self, case, spec=None, *, request_id=None,
+                      priority: int = 0,
+                      deadline_s: Optional[float] = None,
+                      **spec_kwargs) -> Future:
+        """Admit a DESIGN request (BOOST sizing frontier) with the same
+        bounded, jittered retry-after backoff as :meth:`submit`."""
+        attempt = 0
+        while True:
+            try:
+                return self.service.submit_design(
+                    case, spec, request_id=request_id, priority=priority,
+                    deadline_s=deadline_s, **spec_kwargs)
+            except QueueFullError as e:
+                attempt += 1
+                if attempt > self.max_retries:
+                    raise
+                wait = self._backoff_s(e.retry_after_s)
+                TellUser.info(
+                    f"client: queue full, design retry {attempt}/"
+                    f"{self.max_retries} in {wait:.2f}s")
+                time.sleep(wait)
+
+    def design(self, case, spec=None, *,
+               timeout: Optional[float] = None, **kwargs):
+        """Submit a design request and block for its
+        :class:`~dervet_tpu.design.frontier.DesignFrontier`.  Check
+        ``frontier.fidelity`` — a ``"degraded"`` frontier was load-shed
+        and is ranked by the ordinal screen only (no certificates)."""
+        return self.submit_design(case, spec, **kwargs).result(
+            timeout=timeout)

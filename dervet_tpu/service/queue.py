@@ -42,13 +42,22 @@ from ..utils.errors import (BreakerOpenError, DeadlineExpiredError,  # noqa: F40
 
 class QueuedRequest:
     """One admitted submission: the cases to solve, admission metadata,
-    and the future the result is delivered through."""
+    and the future the result is delivered through.
+
+    ``kind`` distinguishes the request types the service serves:
+    ``"scenario"`` (solve these cases) and ``"design"`` (BOOST sizing —
+    ``design_case``/``design_spec`` carry the base case + spec, the
+    screening phase fills ``cases`` with the finalist candidate cases,
+    and ``design_state`` carries the screening results to frontier
+    assembly at delivery)."""
 
     __slots__ = ("request_id", "cases", "priority", "deadline", "future",
-                 "seq", "t_submit", "fingerprint")
+                 "seq", "t_submit", "fingerprint", "kind", "design_case",
+                 "design_spec", "design_state")
 
     def __init__(self, request_id: str, cases: Dict, priority: int = 0,
-                 deadline_s: Optional[float] = None, seq: int = 0):
+                 deadline_s: Optional[float] = None, seq: int = 0,
+                 kind: str = "scenario"):
         self.request_id = str(request_id)
         self.cases = cases
         self.priority = int(priority)
@@ -60,6 +69,10 @@ class QueuedRequest:
         # content fingerprint (poison-quarantine registry key), set by
         # the service at admission; None for direct queue users
         self.fingerprint: Optional[str] = None
+        self.kind = str(kind)
+        self.design_case = None
+        self.design_spec = None
+        self.design_state = None
 
     def expired(self) -> bool:
         return self.deadline is not None and time.monotonic() > self.deadline

@@ -149,6 +149,12 @@ class LoadShedder:
         self._last_expired = 0
         self.shed_rounds = 0
         self.degraded_requests = 0
+        # per-request-TYPE shed accounting ("scenario" vs "design"), so
+        # design-screening load is distinguishable from scenario load in
+        # service.metrics() — a shed design request is answered with a
+        # screening-only frontier, a shed scenario request with a
+        # degraded screening dispatch
+        self.degraded_by_kind: Dict[str, int] = {}
 
     def observe(self, depth: int, max_depth: int, expired_total: int
                 ) -> bool:
@@ -172,12 +178,17 @@ class LoadShedder:
         if degraded:
             self.shed_rounds += 1
             self.degraded_requests += len(degraded)
+            for r in degraded:
+                kind = getattr(r, "kind", "scenario") or "scenario"
+                self.degraded_by_kind[kind] = \
+                    self.degraded_by_kind.get(kind, 0) + 1
         return certified, degraded
 
     def snapshot(self) -> Dict:
         return {"engaged_streak": self._consecutive,
                 "shed_rounds": self.shed_rounds,
                 "degraded_requests": self.degraded_requests,
+                "degraded_by_kind": dict(self.degraded_by_kind),
                 "threshold_frac": self.threshold_frac,
                 "shed_priority_max": self.shed_priority_max}
 

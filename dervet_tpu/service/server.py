@@ -118,6 +118,18 @@ class ScenarioService:
         # screening solver (loose tolerance, short budget) must never be
         # handed to a certified-tier round sharing the structure key
         self.degraded_cache = SolverCache(pad_grid=(backend != "cpu"))
+        # design requests (BOOST sizing): persistent per-tier screening
+        # caches — a warm service screens a repeat population with zero
+        # XLA compiles; finalists ride the certified solver_cache above
+        from ..design.screen import ScreeningCaches
+        self.design_caches = ScreeningCaches(pad_grid=(backend != "cpu"))
+        self._design = {"requests": 0, "candidates": 0, "screen_rounds": 0,
+                        "screen_s": 0.0, "finalists": 0,
+                        "degraded_answers": 0, "screen_dispatches": 0,
+                        "screen_compile_events": 0}
+        # the last design screening's per-round stats (the zero-compile
+        # warm observable the design smoke gates on)
+        self.last_screen_stats: Optional[Dict] = None
         # backend-loss recovery policy + poison-request registry
         self.recovery = resilience.BackendRecovery(
             max_reinits=backend_max_reinits)
@@ -170,6 +182,41 @@ class ScenarioService:
             cases = dict(enumerate(cases))
         if not cases:
             raise ValueError("a request needs at least one case")
+        fingerprint = resilience.request_fingerprint(cases)
+        return self._admit(request_id, fingerprint, priority, deadline_s,
+                           cases=cases)
+
+    def submit_design(self, case, spec=None, *, request_id=None,
+                      priority: int = 0,
+                      deadline_s: Optional[float] = None,
+                      **spec_kwargs) -> Future:
+        """Admit one DESIGN request (BOOST sizing): screen a candidate
+        population over ``spec``'s bounds, certify the top-k, deliver a
+        :class:`~dervet_tpu.design.frontier.DesignFrontier` through the
+        returned future.  Admission semantics (priority, deadline,
+        backpressure, poison blocklist, draining) are identical to
+        :meth:`submit` — a design request is just another request type.
+
+        ``spec`` is a :class:`~dervet_tpu.design.population.DesignSpec`;
+        alternatively pass its fields as keyword arguments."""
+        from ..design.population import DesignSpec
+        from ..design.service import design_fingerprint
+        if self._draining.is_set():
+            raise ServiceClosedError(
+                "service is draining — no new admissions")
+        if spec is None:
+            spec = DesignSpec(**spec_kwargs)
+        spec.validate()       # spec errors raise HERE, at admission
+        fingerprint = design_fingerprint(case, spec)
+        return self._admit(request_id, fingerprint, priority, deadline_s,
+                           kind="design", design_case=case,
+                           design_spec=spec)
+
+    def _admit(self, request_id, fingerprint, priority, deadline_s, *,
+               cases=None, kind: str = "scenario", design_case=None,
+               design_spec=None) -> Future:
+        """Shared admission tail: backend breaker, poison blocklist,
+        id allocation/validation, queue put with typed rejection."""
         if self.breakers.is_open("backend"):
             # the service is alive but cannot currently solve (backend
             # re-init AND the CPU failover both failed): fail fast with
@@ -182,7 +229,6 @@ class ScenarioService:
         # poison blocklist: a request whose content fingerprint crashed
         # the dispatch twice is rejected in microseconds here, instead
         # of re-crashing a round it would share with innocents
-        fingerprint = resilience.request_fingerprint(cases)
         diagnosis = self.poison_registry.blocked(fingerprint)
         if diagnosis is not None:
             raise PoisonRequestError(
@@ -205,9 +251,12 @@ class ScenarioService:
                     "wait for its future (or pick a new id) before "
                     "resubmitting")
             self._active_ids.add(str(request_id))
-        req = QueuedRequest(request_id, cases, priority=priority,
-                            deadline_s=deadline_s)
+        req = QueuedRequest(request_id, cases if cases is not None else {},
+                            priority=priority, deadline_s=deadline_s,
+                            kind=kind)
         req.fingerprint = fingerprint
+        req.design_case = design_case
+        req.design_spec = design_spec
         req.future.add_done_callback(
             lambda _f, rid=str(request_id): self._release_id(rid))
         try:
@@ -228,6 +277,17 @@ class ScenarioService:
         from ..io.params import Params
         cases = Params.initialize(path, base_path=base_path)
         return self.submit(cases, **kwargs)
+
+    def submit_design_file(self, path, base_path=None, **kwargs) -> Future:
+        """Admit a spool ``design.json`` request file (see
+        ``design.service.parse_design_request`` for the shape); parse
+        errors raise here, at admission."""
+        import json
+        from ..design.service import parse_design_request
+        with open(path) as f:
+            payload = json.load(f)
+        case, spec = parse_design_request(payload, base_path=base_path)
+        return self.submit_design(case, spec, **kwargs)
 
     # -- batching loop --------------------------------------------------
     def start(self) -> "ScenarioService":
@@ -291,7 +351,46 @@ class ScenarioService:
                     f"({len(certified)} stay certified)")
         else:
             certified, degraded = requests, []
+        # design requests take the BOOST path: their populations screen
+        # NOW (one DesignRound, the service's persistent per-tier caches)
+        # and the survivors' finalist cases join the certified round
+        # below, co-batching with ordinary scenario requests.  A design
+        # request the shedder picked is answered from the screen alone
+        # (degraded frontier) — it never reaches the certified round.
+        design_shed_ids = {r.request_id for r in degraded
+                           if r.kind == "design"}
+        design_reqs = [r for r in certified + degraded
+                       if r.kind == "design"]
+        certified = [r for r in certified if r.kind != "design"]
+        degraded = [r for r in degraded if r.kind != "design"]
         served = 0
+        if design_reqs:
+            from ..design.service import DesignRound
+            dr = DesignRound(design_reqs, backend=self.backend,
+                             solver_opts=self.solver_opts,
+                             caches=self.design_caches,
+                             degraded_ids=design_shed_ids,
+                             supervisor=self.supervisor)
+            try:
+                dr.run()
+            except BaseException as e:
+                # the screening phase answers its own requests (incl.
+                # preemption); every OTHER request this cycle already
+                # popped from the queue must be answered here or its
+                # client hangs forever
+                for req in design_reqs + degraded + certified:
+                    if not req.future.done():
+                        req.future.set_exception(ServiceClosedError(
+                            f"request {req.request_id!r} not dispatched: "
+                            "the design screening phase failed "
+                            f"({e}) — resubmit"))
+                        with self._metrics_lock:
+                            self._requests["failed"] += 1
+                self._absorb_design_stats(dr)
+                raise
+            self._absorb_design_stats(dr)
+            served += len(dr.answered)
+            certified = certified + dr.finalist_requests
         tiers = [(reqs, is_degraded)
                  for reqs, is_degraded in ((degraded, True),
                                            (certified, False)) if reqs]
@@ -342,6 +441,36 @@ class ScenarioService:
                 self._absorb_request_outcomes(rnd)
             served += len(rnd.requests)
         return served
+
+    def _absorb_design_stats(self, dr) -> None:
+        """Design screening bookkeeping: screening-load counters (kept
+        separate from scenario round counters so the two workloads are
+        distinguishable in ``metrics()``), plus request accounting for
+        the design requests the screening phase answered itself
+        (degraded frontiers, screen failures, expiries)."""
+        st = dr.stats
+        with self._metrics_lock:
+            self._design["requests"] += int(st.get("requests", 0))
+            self._design["candidates"] += int(st.get("candidates", 0))
+            self._design["screen_rounds"] += int(st.get("screen_rounds",
+                                                        0))
+            self._design["screen_s"] += float(st.get("screen_s", 0.0))
+            self._design["finalists"] += int(st.get("finalists", 0))
+            self._design["degraded_answers"] += int(st.get("degraded", 0))
+            self._design["screen_dispatches"] += int(
+                st.get("dispatches", 0))
+            self._design["screen_compile_events"] += int(
+                st.get("compile_events", 0))
+            for req in dr.answered:
+                fut = req.future
+                if fut.done() and fut.exception() is None:
+                    self._requests["completed"] += 1
+                    self._latencies.append(
+                        time.monotonic() - req.t_submit)
+                elif fut.done():
+                    self._requests["failed"] += 1
+        if dr.last_screen is not None:
+            self.last_screen_stats = dr.last_screen
 
     def _absorb_round_stats(self, rnd: BatchRound) -> None:
         """Round-level bookkeeping, fired by the batcher BEFORE any
@@ -448,6 +577,12 @@ class ScenarioService:
             lat = np.asarray(self._latencies, dtype=float)
             rounds = dict(self._rounds)
             requests = dict(self._requests)
+            design = dict(self._design)
+        design["screen_s"] = round(design["screen_s"], 3)
+        design["screen_candidates_per_s"] = round(
+            design["candidates"] / design["screen_s"], 2) \
+            if design["screen_s"] else None
+        design["caches"] = self.design_caches.snapshot()
         groups = rounds.pop("batch_sum"), rounds["device_groups"]
         cache = self.solver_cache
         lookups = cache.builds + cache.hits
@@ -459,6 +594,9 @@ class ScenarioService:
             "requests": {**requests,
                          "pending": self.queue.depth()},
             "rounds": rounds,
+            # design-service load, separate from scenario rounds so the
+            # two request types are distinguishable under pressure
+            "design": design,
             "batch_occupancy": {
                 "mean_windows_per_device_batch":
                     round(groups[0] / groups[1], 2) if groups[1] else 0.0,
@@ -629,9 +767,26 @@ def serve_main(argv=None) -> int:
                 if rid in pending:
                     continue
                 try:
-                    fut = service.submit_params(path,
-                                                base_path=args.base_path,
-                                                request_id=rid)
+                    # a JSON file with a top-level "design" object is a
+                    # BOOST design request (base case + bounds spec),
+                    # not a model-parameters file
+                    is_design = False
+                    if path.suffix.lower() == ".json":
+                        from ..design.service import is_design_payload
+                        try:
+                            with open(path) as fh:
+                                is_design = is_design_payload(
+                                    json.load(fh))
+                        except Exception:
+                            is_design = False   # params path reports it
+                    if is_design:
+                        fut = service.submit_design_file(
+                            path, base_path=args.base_path,
+                            request_id=rid)
+                    else:
+                        fut = service.submit_params(
+                            path, base_path=args.base_path,
+                            request_id=rid)
                 except QueueFullError as e:
                     TellUser.warning(
                         f"serve: {rid} deferred (queue full), retrying "

@@ -1,0 +1,137 @@
+"""CI smoke: the BOOST design service on the cpu XLA backend, no chip.
+
+Boots a :class:`~dervet_tpu.service.server.ScenarioService`
+(backend="jax" on a CPU XLA device — the same no-hardware analogue the
+serve smoke uses), submits one 512-candidate design request (top-8
+certified frontier), and asserts the design contract:
+
+* the frontier is non-empty and 100% of finalists carry an accepted
+  PR-4 float64 certificate;
+* the certified winner's SCREENING rank is within the top-k (the
+  ordinal screen actually ordered the population);
+* the screening phase rode the batch axis: its device-dispatch count is
+  at least 10x smaller than solving the candidates solo would cost
+  (>= 1 dispatch per candidate);
+* a WARM repeat of the same request compiles ZERO XLA programs in both
+  the screening tiers and the certified round (the persistent per-tier
+  screening caches + bucket-grid padding).
+
+Env knobs: SMOKE_POPULATION (default 512), SMOKE_TOPK (default 8),
+SMOKE_HOURS (default 72 — the synthetic case's horizon).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def make_case(hours: int):
+    from dervet_tpu.benchlib import synthetic_case
+    c = synthetic_case()
+    c.scenario["allow_partial_year"] = True
+    c.datasets.time_series = c.datasets.time_series.iloc[:hours]
+    return c
+
+
+def main() -> int:
+    from dervet_tpu.design import DERBounds, DesignSpec
+    from dervet_tpu.service import ScenarioService
+
+    population = int(os.environ.get("SMOKE_POPULATION", "512"))
+    top_k = int(os.environ.get("SMOKE_TOPK", "8"))
+    hours = int(os.environ.get("SMOKE_HOURS", "72"))
+
+    spec = DesignSpec(
+        bounds={("Battery", "1"): DERBounds(kw=(250.0, 2500.0),
+                                            kwh=(500.0, 9000.0))},
+        population=population, top_k=top_k, refine_rounds=1)
+
+    svc = ScenarioService(backend="jax", max_wait_s=0.05)
+    svc.start()
+    try:
+        frontier = svc.submit_design(make_case(hours), spec,
+                                     request_id="smoke-design").result(
+                                         timeout=1800)
+        # -- gates -----------------------------------------------------
+        if frontier.frontier is None or not len(frontier.frontier):
+            raise AssertionError("frontier is empty")
+        if not frontier.all_finalists_certified:
+            raise AssertionError(
+                "not every finalist certified:\n"
+                + frontier.frontier[["certified", "reason"]].to_string())
+        winner = frontier.winner
+        if not (1 <= int(winner["screen_rank"]) <= top_k):
+            raise AssertionError(
+                f"certified winner's screening rank "
+                f"{winner['screen_rank']} outside top-{top_k} — the "
+                "ordinal screen is not ordering the population")
+        # the non-tautological ordinal-health gate (finalists are BY
+        # CONSTRUCTION the screen's top-k, so the rank gate above can
+        # only catch bookkeeping bugs): screening order must correlate
+        # with certified order among the finalists
+        corr = frontier.rank_correlation
+        if corr is not None and corr < 0.5:
+            raise AssertionError(
+                f"screening-vs-certified rank correlation {corr} < 0.5 "
+                "— the ordinal screen is not ordering this family")
+        screen_dispatches = frontier.screen["dispatches"]
+        n_windows = population      # one window per candidate at 72 h
+        if screen_dispatches * 10 > n_windows:
+            raise AssertionError(
+                f"screening used {screen_dispatches} device dispatches "
+                f"for {population} candidates — less than the 10x "
+                "batching win over solo solves (>= 1 dispatch each)")
+        cold_screen_compiles = frontier.screen["compile_events"]
+
+        # -- warm repeat: zero compiles anywhere -----------------------
+        compiles_before = svc.metrics()["rounds"]["compile_events"]
+        warm = svc.submit_design(make_case(hours), spec,
+                                 request_id="smoke-design-warm").result(
+                                     timeout=1800)
+        warm_screen_compiles = warm.screen["compile_events"]
+        warm_round_compiles = (svc.metrics()["rounds"]["compile_events"]
+                               - compiles_before)
+        if warm_screen_compiles or warm_round_compiles:
+            raise AssertionError(
+                f"warm repeat compiled {warm_screen_compiles} screening "
+                f"+ {warm_round_compiles} certified-round program(s) — "
+                "the warm design path must compile nothing")
+        if not warm.all_finalists_certified:
+            raise AssertionError("warm repeat lost certification")
+        m = svc.metrics()
+    finally:
+        svc.drain()
+
+    print(json.dumps({
+        "smoke": "design", "ok": True,
+        "population": population, "top_k": top_k,
+        "screen_dispatches": int(screen_dispatches),
+        "solo_dispatch_floor": int(n_windows),
+        "batching_win_x": round(n_windows / max(1, screen_dispatches), 1),
+        "cold_screen_compile_events": int(cold_screen_compiles),
+        "warm_screen_compile_events": int(warm_screen_compiles),
+        "warm_round_compile_events": int(warm_round_compiles),
+        "winner": {k: (float(winner[k]) if k != "certified"
+                       else bool(winner[k]))
+                   for k in ("kW", "kWh", "total", "screen_rank",
+                             "certified")},
+        "rank_correlation": frontier.rank_correlation,
+        "screen_candidates_per_s":
+            m["design"]["screen_candidates_per_s"],
+        "design_metrics": {k: m["design"][k] for k in
+                           ("requests", "candidates", "finalists",
+                            "screen_rounds")},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

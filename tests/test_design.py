@@ -1,0 +1,332 @@
+"""Design subsystem (BOOST ordinal-optimization sizing): population
+generation, the ordinal screen, and the certified frontier.
+
+The contract under test:
+
+* populations are DETERMINISTIC (Halton low-discrepancy sampling; same
+  spec -> same candidates), respect the bounds and the ESS duration
+  coupling, and explicit grids are deduplicated + sorted so no candidate
+  ever solves twice;
+* screening is ORDINAL-ONLY: it rides the batched dispatch with the
+  loose screening tiers, certification is forced off thread-locally,
+  and no screening answer ever carries a certificate;
+* the whole population rides the batch axis — the screening device-
+  dispatch count is far below one-dispatch-per-candidate;
+* the certified frontier's finalists each carry a full PR-4 float64
+  certificate, the screening-vs-final rank correlation is reported, and
+  dominated candidates are masked;
+* ``sizing_sweep`` remains a faithful legacy surface over the engine:
+  same columns, deduped/sorted grid, same guard errors.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from dervet_tpu.benchlib import synthetic_case
+from dervet_tpu.design import (DERBounds, DesignSpec, dominated_mask,
+                               generate_population, halton, run_design,
+                               spearman_rank)
+from dervet_tpu.design.frontier import FIDELITY_DEGRADED
+from dervet_tpu.design.population import candidate_case
+from dervet_tpu.design.screen import screen_candidates
+from dervet_tpu.utils.errors import ParameterError
+
+
+def _case(hours: int = 72, **kw):
+    c = synthetic_case(**kw)
+    c.scenario["allow_partial_year"] = True
+    c.datasets.time_series = c.datasets.time_series.iloc[:hours]
+    return c
+
+
+def _spec(**over):
+    base = dict(bounds={("Battery", "1"): DERBounds(kw=(500.0, 2500.0),
+                                                    kwh=(1000.0, 9000.0))},
+                population=12, top_k=3, refine_rounds=1)
+    base.update(over)
+    return DesignSpec(**base)
+
+
+# ---------------------------------------------------------------------------
+# Population generation
+# ---------------------------------------------------------------------------
+
+class TestPopulation:
+    def test_halton_covers_unit_box(self):
+        pts = halton(256, 3)
+        assert pts.shape == (256, 3)
+        assert pts.min() >= 0.0 and pts.max() < 1.0
+        # low-discrepancy: every octant of the box is populated
+        octant = (pts > 0.5) @ np.array([1, 2, 4])
+        assert set(octant.tolist()) == set(range(8))
+
+    def test_population_deterministic_and_in_bounds(self):
+        a = generate_population(_spec(population=64))
+        b = generate_population(_spec(population=64))
+        assert [c.sizes for c in a] == [c.sizes for c in b]
+        for c in a:
+            (tag, der_id, kw, kwh), = c.sizes
+            assert 500.0 <= kw <= 2500.0
+            assert 1000.0 <= kwh <= 9000.0
+
+    def test_duration_coupling_bounds_energy(self):
+        pop = generate_population(_spec(population=64,
+                                        duration_hours=(1.0, 3.0)))
+        for c in pop:
+            (_, _, kw, kwh), = c.sizes
+            # clipped into BOTH the duration box and the kwh bounds
+            assert 1000.0 <= kwh <= 9000.0
+            assert kwh <= kw * 3.0 + 1e-9 or kwh == 1000.0
+
+    def test_explicit_grid_dedupes_and_sorts(self):
+        spec = _spec(population=0, refine_rounds=0,
+                     grid=[(1000, 4000), (500, 1000), (500, 1000),
+                           (1000, 4000)])
+        pop = generate_population(spec)
+        pairs = [(c.sizes[0][2], c.sizes[0][3]) for c in pop]
+        assert pairs == [(500.0, 1000.0), (1000.0, 4000.0)]
+        assert all(c.source == "grid" for c in pop)
+
+    def test_candidate_case_shares_frames_but_not_keys(self):
+        case = _case()
+        pop = generate_population(_spec(population=2))
+        c0 = candidate_case(case, pop[0])
+        # the time-series frame is shared (no 512x data copies) ...
+        assert c0.datasets.time_series is case.datasets.time_series
+        # ... but the Datasets holder and the key dicts are private
+        assert c0.datasets is not case.datasets
+        (tag, der_id, kw, kwh), = pop[0].sizes
+        got = next(k for t, i, k in c0.ders if t == "Battery")
+        base = next(k for t, i, k in case.ders if t == "Battery")
+        assert got["ene_max_rated"] == kwh
+        assert base["ene_max_rated"] != kwh
+
+    def test_spec_validation(self):
+        with pytest.raises(ParameterError, match="top_k"):
+            _spec(top_k=0).validate()
+        with pytest.raises(ParameterError, match="bounds"):
+            DesignSpec(bounds={}).validate()
+        with pytest.raises(ParameterError, match="lo <= hi"):
+            _spec(bounds={("Battery", "1"):
+                          DERBounds(kw=(2000.0, 500.0))}).validate()
+        with pytest.raises(ParameterError, match="storage"):
+            _spec(bounds={("PV", "1"):
+                          DERBounds(kw=(1.0, 2.0),
+                                    kwh=(1.0, 2.0))}).validate()
+        with pytest.raises(ParameterError, match="ONE sized DER"):
+            DesignSpec(bounds={
+                ("Battery", "1"): DERBounds(kw=(1.0, 2.0)),
+                ("PV", "1"): DERBounds(kw=(1.0, 2.0))},
+                grid=[(1.0, 1.0)]).validate()
+
+    def test_missing_der_raises(self):
+        case = _case()
+        spec = _spec(bounds={("CAES", "9"): DERBounds(kw=(1.0, 2.0),
+                                                      kwh=(1.0, 2.0))},
+                     population=4)
+        pop = generate_population(spec)
+        with pytest.raises(ParameterError, match="no CAES"):
+            candidate_case(case, pop[0])
+
+
+# ---------------------------------------------------------------------------
+# Frontier math helpers
+# ---------------------------------------------------------------------------
+
+class TestFrontierMath:
+    def test_spearman_perfect_and_inverted(self):
+        assert spearman_rank([1, 2, 3, 4], [10, 20, 30, 40]) == 1.0
+        assert spearman_rank([1, 2, 3, 4], [40, 30, 20, 10]) == -1.0
+        assert spearman_rank([1], [1]) is None
+
+    def test_dominated_mask(self):
+        # (capex, op): the cheap-and-good point dominates the
+        # expensive-and-bad one; the diagonal trade-off survives
+        capex = [100, 200, 300]
+        op = [-50, -60, -40]
+        out = dominated_mask(capex, op)
+        assert list(out) == [False, False, True]
+        # duplicates never dominate each other
+        assert list(dominated_mask([1, 1], [2, 2])) == [False, False]
+
+
+# ---------------------------------------------------------------------------
+# Screening + certified frontier (end to end, small population)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def frontier():
+    return run_design(_case(), _spec(), backend="jax")
+
+
+class TestDesignEngine:
+    def test_frontier_certified_and_ranked(self, frontier):
+        f = frontier.frontier
+        assert len(f) == 3
+        assert f["certified"].all()
+        assert frontier.all_finalists_certified
+        assert list(f["final_rank"]) == [1, 2, 3]
+        # certified totals are ranked ascending (lower = better)
+        assert (np.diff(f["total"].to_numpy()) >= 0).all()
+        # the winner came from within the screen's own top-k
+        assert 1 <= int(frontier.winner["screen_rank"]) <= 3
+        assert frontier.rank_correlation is not None
+
+    def test_population_surface_complete(self, frontier):
+        pop = frontier.population
+        assert len(pop) == 12
+        conv = pop[pop.converged]
+        # every converged candidate got a rank; ranks are 1..n unique
+        ranks = sorted(conv["screen_rank"].dropna())
+        assert ranks == list(range(1, len(conv) + 1))
+        # refinement actually re-screened a SUBSET at the tighter tier
+        assert (conv["screen_round"] == 1).sum() < len(conv)
+        assert (conv["screen_round"] == 1).sum() >= 3
+
+    def test_screening_rides_the_batch_axis(self, frontier):
+        # 12 candidates over 2 rounds: solo solves would cost >= 12
+        # dispatches for round 0 alone; the batched screen stays far
+        # below one dispatch per candidate
+        assert frontier.screen["dispatches"] * 2 <= 12
+        assert frontier.screen["candidates"] == 12
+
+    def test_screening_never_certificate_stamped(self, frontier):
+        # the ordinal tier must not have issued certificates; the
+        # certified phase's counts live in run_health instead
+        assert frontier.screen["certification_stamped"] is False
+        cert = frontier.run_health["certification"]
+        n_windows = int(sum(
+            frontier.run_health["windows"][k]
+            for k in ("clean", "inaccurate", "retried", "cpu_fallback")))
+        assert cert["windows_certified"] == n_windows
+        assert cert["windows"]["rejected_final"] == 0
+
+    def test_save_as_csv_artifacts(self, frontier, tmp_path):
+        frontier.save_as_csv(tmp_path)
+        assert (tmp_path / "design_frontier.csv").exists()
+        assert (tmp_path / "design_population.csv").exists()
+        payload = json.loads((tmp_path / "design_frontier.json")
+                             .read_text())
+        assert payload["fidelity"] == "certified"
+        assert len(payload["frontier"]) == 3
+        assert payload["spec"]["top_k"] == 3
+        assert (tmp_path / "run_health.json").exists()
+
+    def test_degraded_engine_path(self):
+        f = run_design(_case(), _spec(population=8, top_k=2,
+                                      refine_rounds=0),
+                       backend="jax", certify=False)
+        assert f.fidelity == FIDELITY_DEGRADED
+        assert f.resubmit_hint is not None
+        assert not f.frontier["certified"].any()
+        # the degraded frontier is the screening order itself
+        assert f.rank_correlation == 1.0
+
+    def test_budget_cap_filters_and_reports(self):
+        # capex ~ 200*kW + 100*kWh (+ ccost): a tight budget kills the
+        # big candidates before any solve
+        report = screen_candidates(
+            _case(), generate_population(_spec(population=12)),
+            backend="jax", refine_rounds=0, top_k=3, budget=800_000.0)
+        filtered = [e for e in report.entries if not e.feasible]
+        assert filtered and all("budget" in e.reason for e in filtered)
+        assert all(not np.isfinite(e.total) for e in filtered)
+        # the cap never silently empties the screen below the survivors
+        assert report.converged
+
+    def test_budget_filtering_everything_raises(self):
+        with pytest.raises(ParameterError, match="filtered out"):
+            screen_candidates(
+                _case(), generate_population(_spec(population=4)),
+                backend="jax", refine_rounds=0, budget=1.0)
+
+    def test_refinement_failure_keeps_prior_scores(self, monkeypatch):
+        """A refinement round that fails wholesale must not invert the
+        ordering: survivors keep their valid round-0 scores instead of
+        handing the frontier to the refinement-cut candidates."""
+        import dervet_tpu.design.screen as screen_mod
+        from dervet_tpu.utils.errors import AggregatedSolverError
+        real = screen_mod.run_dispatch
+        calls = {"n": 0}
+
+        def flaky(scens, **kw):
+            calls["n"] += 1
+            if calls["n"] == 2:     # the refinement round dies
+                raise AggregatedSolverError(
+                    {s.case.case_id: "injected round failure"
+                     for s in scens})
+            return real(scens, **kw)
+
+        monkeypatch.setattr(screen_mod, "run_dispatch", flaky)
+        report = screen_candidates(
+            _case(), generate_population(_spec(population=8)),
+            backend="jax", refine_rounds=1, refine_keep=0.5, top_k=2)
+        assert calls["n"] == 2
+        # every candidate still ranked on its round-0 score
+        assert len(report.converged) == 8
+        assert all(e.screen_round == 0 for e in report.entries)
+        # the survivors of the cut carry the failure note, and the top
+        # of the ranking is still drawn from them (not the cut tail)
+        noted = [e for e in report.entries if e.reason]
+        assert noted and all("refinement round 1 failed" in e.reason
+                             for e in noted)
+        assert report.top(2)[0].reason is not None
+
+    def test_zero_size_candidate_rejected_anywhere_in_population(self):
+        """is_sizing_optimization depends on the CANDIDATE's sizes: a
+        zero-rating grid point that doesn't sort first must still be
+        refused (it would be silently re-sized by the optimizer)."""
+        spec = _spec(population=0, refine_rounds=0,
+                     grid=[(500.0, 1000.0), (1000.0, 0.0)])
+        with pytest.raises(ParameterError, match="candidate 1.*"
+                                                 "FIXED-size"):
+            screen_candidates(_case(), generate_population(spec),
+                              backend="jax", refine_rounds=0, top_k=1)
+
+    def test_grid_without_bounds_rejected_at_validate(self):
+        with pytest.raises(ParameterError, match="grid needs bounds"):
+            DesignSpec(bounds={}, grid=[(500.0, 1000.0)]).validate()
+
+    def test_binary_case_rejected(self):
+        c = _case()
+        c.scenario["binary"] = 1
+        with pytest.raises(ParameterError, match="binary"):
+            run_design(c, _spec(population=4, top_k=1, refine_rounds=0),
+                       backend="jax")
+
+    def test_sizing_case_rejected(self):
+        # a zero rating on a NON-target DER would add a size variable
+        # the candidate overrides can't reach — the fixed-size guard
+        # must refuse before any device work
+        c = _case()
+        for tag, _id, keys in c.ders:
+            if tag == "PV":
+                keys["rated_capacity"] = 0
+        with pytest.raises(ParameterError, match="FIXED-size"):
+            run_design(c, _spec(population=4, top_k=1, refine_rounds=0),
+                       backend="jax")
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+class TestDesignCLI:
+    def test_parse_bounds(self):
+        from dervet_tpu.design.cli import parse_bounds
+        assert parse_bounds("kw=200:2000,kwh=500:8000") == {
+            "kw": (200.0, 2000.0), "kwh": (500.0, 8000.0)}
+        assert parse_bounds("kw=1:2") == {"kw": (1.0, 2.0)}
+        with pytest.raises(ParameterError):
+            parse_bounds("mw=1:2")
+        with pytest.raises(ParameterError):
+            parse_bounds("kw=12")
+
+    def test_parser_maps_flags(self):
+        from dervet_tpu.design.cli import build_parser
+        args = build_parser().parse_args(
+            ["case.csv", "--bounds", "kw=1:2,kwh=3:4",
+             "--population", "64", "--top-k", "4", "--backend", "cpu"])
+        assert args.population == 64 and args.top_k == 4
+        assert args.backend == "cpu"

@@ -1,0 +1,330 @@
+"""Design requests through the scenario service: admission, screening
+fidelity isolation, finalist co-batching, load-shed degraded frontiers,
+spool serving.
+
+The integration contract under test:
+
+* a design request rides the SAME admission queue (priority, deadline,
+  backpressure, duplicate-id, draining) as scenario requests and
+  delivers a :class:`DesignFrontier` through its future;
+* fidelity isolation: a design request CO-BATCHED with a certified
+  scenario request leaves the scenario answer 100% certified while the
+  screening answers are never certificate-stamped — the PR-6
+  thread-local policy drill extended to the design path;
+* finalists genuinely coalesce with scenario windows in the certified
+  round (a shared ledger group tagged with both request ids);
+* a load-SHED design request is answered with the screening-only
+  DEGRADED frontier (explicit mark + resubmit hint, zero certificates)
+  and the shed accounting is visible PER REQUEST TYPE in metrics();
+* ``design.json`` files in the spool's incoming/ serve end to end.
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+from dervet_tpu.benchlib import synthetic_case, synthetic_sensitivity_cases
+from dervet_tpu.design import DERBounds, DesignSpec, DesignFrontier
+from dervet_tpu.service import (DeadlineExpiredError, ScenarioClient,
+                                ScenarioService, ServiceClosedError)
+from dervet_tpu.utils.errors import ParameterError
+
+
+def _case(hours: int = 72, seed: int = 0):
+    c = synthetic_case(seed=seed)
+    c.scenario["allow_partial_year"] = True
+    c.datasets.time_series = c.datasets.time_series.iloc[:hours]
+    return c
+
+
+def _scen_cases(n: int = 1, hours: int = 72):
+    out = {}
+    for i, c in enumerate(synthetic_sensitivity_cases(n, months=0,
+                                                      seed=1)):
+        c.datasets.time_series = c.datasets.time_series.iloc[:hours]
+        c.scenario["allow_partial_year"] = True
+        out[i] = c
+    return out
+
+
+def _spec(**over):
+    base = dict(bounds={("Battery", "1"): DERBounds(kw=(500.0, 2500.0),
+                                                    kwh=(1000.0, 9000.0))},
+                population=8, top_k=2, refine_rounds=0)
+    base.update(over)
+    return DesignSpec(**base)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end request
+# ---------------------------------------------------------------------------
+
+class TestDesignRequest:
+    def test_design_request_end_to_end(self):
+        svc = ScenarioService(backend="jax", max_wait_s=0.0)
+        fut = svc.submit_design(_case(), _spec(), request_id="d1")
+        assert svc.run_once() == 1
+        fr = fut.result(0)
+        assert isinstance(fr, DesignFrontier)
+        assert fr.request_id == "d1"
+        assert fr.fidelity == "certified"
+        assert fr.all_finalists_certified
+        assert fr.request_latency_s is not None
+        # per-request observability: health + ledger slices exist
+        assert fr.run_health["certification"]["enabled"]
+        assert fr.run_health["design"]["candidates"] == 8
+        assert fr.solve_ledger["request_id"] == "d1"
+        m = svc.metrics()
+        assert m["design"]["requests"] == 1
+        assert m["design"]["candidates"] == 8
+        assert m["design"]["finalists"] == 2
+        assert m["requests"]["completed"] == 1
+        svc.close()
+
+    def test_invalid_spec_rejected_at_admission(self):
+        svc = ScenarioService(backend="cpu")
+        with pytest.raises(ParameterError):
+            svc.submit_design(_case(), _spec(top_k=0))
+        with pytest.raises(ParameterError):
+            svc.submit_design(_case(), None, bounds={})
+        svc.close()
+
+    def test_draining_service_rejects_design(self):
+        svc = ScenarioService(backend="cpu")
+        svc.request_stop()
+        with pytest.raises(ServiceClosedError):
+            svc.submit_design(_case(), _spec())
+        svc.close()
+
+    def test_expired_design_request_answered_typed(self):
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0)
+        fut = svc.submit_design(_case(), _spec(), request_id="late",
+                                deadline_s=0.0)
+        time.sleep(0.01)
+        svc.run_once()
+        with pytest.raises(DeadlineExpiredError):
+            fut.result(0)
+        svc.close()
+
+    def test_client_design_blocks_for_frontier(self):
+        svc = ScenarioService(backend="jax", max_wait_s=0.0).start()
+        client = ScenarioClient(svc)
+        fr = client.design(_case(), _spec(), request_id="viaclient",
+                           timeout=600)
+        assert fr.all_finalists_certified
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Fidelity isolation + co-batching (the PR-6 drill, extended)
+# ---------------------------------------------------------------------------
+
+class TestFidelityIsolation:
+    def test_design_cobatch_leaves_scenario_fully_certified(self):
+        """One cycle, one design + one certified scenario request: the
+        scenario answer must be 100% certified, the screening answers
+        must never be certificate-stamped, and the design finalists must
+        co-batch with the scenario windows in the certified round."""
+        svc = ScenarioService(backend="jax", max_wait_s=0.0)
+        f_design = svc.submit_design(_case(), _spec(top_k=3),
+                                     request_id="dsg")
+        f_scen = svc.submit(_scen_cases(2), request_id="scn")
+        assert svc.run_once() == 2
+        res = f_scen.result(0)
+        fr = f_design.result(0)
+        # scenario side: full certification, untouched by the screen
+        assert res.fidelity == "certified"
+        cert = res.run_health["certification"]
+        n_win = sum(len(inst.scenario.windows)
+                    for inst in res.instances.values())
+        assert cert["enabled"] and cert["windows_certified"] == n_win
+        assert cert["windows"]["rejected_final"] == 0
+        # design side: ordinal screen never stamped, finalists certified
+        assert fr.screen["certification_stamped"] is False
+        assert fr.all_finalists_certified
+        # co-batching observable: a certified-round device group carried
+        # windows from BOTH requests
+        shared = [g for g in fr.solve_ledger["groups"]
+                  if {"dsg", "scn"} <= set(g.get("requests") or ())]
+        assert shared, fr.solve_ledger["groups"]
+        assert fr.solve_ledger["coalesced_groups"] >= 1
+        svc.close()
+
+    def test_ambient_policy_unchanged_after_screen(self):
+        """The thread-local certification override must not leak out of
+        the screening dispatch into the service thread's ambient
+        policy."""
+        from dervet_tpu.ops import certify
+        svc = ScenarioService(backend="jax", max_wait_s=0.0)
+        fut = svc.submit_design(_case(), _spec(), request_id="leakcheck")
+        svc.run_once()
+        fut.result(0)
+        assert certify.policy_from_env().enabled
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Load shedding: the degraded design tier + per-type accounting
+# ---------------------------------------------------------------------------
+
+class TestDesignShedding:
+    def _overloaded(self):
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0,
+                              max_queue_depth=8, max_batch_requests=2,
+                              shed_threshold_frac=0.5,
+                              shed_sustain_rounds=1)
+        f_design = svc.submit_design(
+            _case(), _spec(population=6, top_k=2), request_id="shedme",
+            priority=0)
+        futs = [svc.submit(_scen_cases(1), request_id=f"s{i}",
+                           priority=(1 if i % 2 else 0))
+                for i in range(5)]
+        while svc.queue.depth():
+            svc.run_once()
+        return svc, f_design, futs
+
+    def test_shed_design_gets_degraded_frontier(self):
+        svc, f_design, futs = self._overloaded()
+        fr = f_design.result(0)
+        assert fr.fidelity == "degraded"
+        assert "resubmit" in fr.resubmit_hint
+        # screening-only: ranked frontier, zero certificates anywhere
+        assert len(fr.frontier) == 2
+        assert not fr.frontier["certified"].any()
+        assert fr.run_health["fidelity"] == "degraded"
+        svc.close()
+
+    def test_shed_counts_split_by_request_type(self):
+        svc, f_design, futs = self._overloaded()
+        shed = svc.metrics()["resilience"]["load_shedding"]
+        by_kind = shed["degraded_by_kind"]
+        assert by_kind.get("design", 0) >= 1
+        assert by_kind.get("scenario", 0) >= 1
+        assert shed["degraded_requests"] == sum(by_kind.values())
+        # design screening load is its own metrics section, separate
+        # from scenario rounds
+        m = svc.metrics()
+        assert m["design"]["degraded_answers"] >= 1
+        assert m["design"]["candidates"] >= 6
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Warm service: persistent screening caches
+# ---------------------------------------------------------------------------
+
+class TestWarmDesign:
+    def test_warm_repeat_screen_compiles_nothing(self):
+        svc = ScenarioService(backend="jax", max_wait_s=0.0)
+        f1 = svc.submit_design(_case(), _spec(), request_id="cold")
+        svc.run_once()
+        f1.result(0)
+        f2 = svc.submit_design(_case(), _spec(), request_id="warm")
+        svc.run_once()
+        fr = f2.result(0)
+        assert fr.screen["compile_events"] == 0
+        assert svc.last_screen_stats["request_id"] == "warm"
+        assert svc.last_screen_stats["compile_events"] == 0
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Spool front end: design.json
+# ---------------------------------------------------------------------------
+
+def _write_design_spool(tmp_path, population=6, top_k=2):
+    """A spool-shaped design request on disk: a reference-format
+    model-parameters CSV + its time series + the design.json that
+    references them.  Returns the design.json path."""
+    import pandas as pd
+    ts = _case().datasets.time_series
+    ts_path = tmp_path / "ts.csv"
+    # the loader expects hour-ENDING stamps (it shifts back by dt)
+    ts.set_axis(ts.index + pd.Timedelta(hours=1)).rename_axis(
+        "Datetime (he)").to_csv(ts_path)
+    rows = [
+        ("Scenario", "", "dt", "1", "float"),
+        ("Scenario", "", "opt_years", "[2017]", "list/int"),
+        ("Scenario", "", "n", "month", "string/int"),
+        ("Scenario", "", "start_year", "2017", "period"),
+        ("Scenario", "", "end_year", "2017", "period"),
+        ("Scenario", "", "allow_partial_year", "1", "bool"),
+        ("Scenario", "", "incl_site_load", "1", "bool"),
+        ("Scenario", "", "time_series_filename", str(ts_path), "string"),
+        ("Finance", "", "npv_discount_rate", "7", "float"),
+        ("Finance", "", "inflation_rate", "3", "float"),
+        ("Battery", "1", "ch_max_rated", "1000", "float"),
+        ("Battery", "1", "dis_max_rated", "1000", "float"),
+        ("Battery", "1", "ene_max_rated", "4000", "float"),
+        ("Battery", "1", "rte", "85", "float"),
+        ("Battery", "1", "llsoc", "5", "float"),
+        ("Battery", "1", "ulsoc", "100", "float"),
+        ("Battery", "1", "soc_target", "50", "float"),
+        ("PV", "1", "rated_capacity", "3000", "float"),
+        ("PV", "1", "curtail", "1", "bool"),
+        ("DA", "", "growth", "0", "float"),
+    ]
+    df = pd.DataFrame(rows, columns=["Tag", "ID", "Key", "Value", "Type"])
+    df["Active"] = "yes"
+    params_path = tmp_path / "params.csv"
+    df.to_csv(params_path, index=False)
+    design_path = tmp_path / "design.json"
+    design_path.write_text(json.dumps({"design": {
+        "parameters": str(params_path),
+        "der": "Battery", "kw": [500, 2000], "kwh": [1000, 8000],
+        "population": population, "top_k": top_k,
+        "refine_rounds": 0}}))
+    return design_path
+
+
+class TestDesignSpool:
+    def test_parse_design_request_shapes(self):
+        from dervet_tpu.design.service import (is_design_payload,
+                                               parse_design_request)
+        assert is_design_payload({"design": {}})
+        assert not is_design_payload({"Scenario": {}})
+        assert not is_design_payload([1, 2])
+        with pytest.raises(ParameterError, match="parameters"):
+            parse_design_request({"design": {}})
+        with pytest.raises(ParameterError, match="pair"):
+            parse_design_request({"design": {"parameters": "x.csv",
+                                             "kw": [1, 2, 3]}})
+
+    def test_submit_design_file(self, tmp_path):
+        """The spool admission path: a design.json referencing a real
+        model-parameters file parses at admission and serves."""
+        design_path = _write_design_spool(tmp_path)
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0)
+        fut = svc.submit_design_file(design_path, request_id="spool1")
+        svc.run_once()
+        fr = fut.result(0)
+        assert fr.all_finalists_certified
+        fr.save_as_csv(tmp_path / "out")
+        assert (tmp_path / "out" / "design_frontier.csv").exists()
+        saved = json.loads((tmp_path / "out" / "design_frontier.json")
+                           .read_text())
+        assert saved["request_id"] == "spool1"
+        svc.close()
+
+    def test_design_json_serves_through_spool_loop(self, tmp_path):
+        """End to end through ``dervet-tpu serve --once``: a design.json
+        drop becomes a served request with frontier artifacts under
+        results/<rid>/ and the input moved to done/."""
+        from dervet_tpu.service.server import serve_main
+        design_path = _write_design_spool(tmp_path)
+        incoming = tmp_path / "spool" / "incoming"
+        incoming.mkdir(parents=True)
+        design_path.replace(incoming / "mydesign.json")
+        rc = serve_main([str(tmp_path / "spool"), "--once",
+                         "--backend", "cpu"])
+        assert rc == 0
+        out = tmp_path / "spool" / "results" / "mydesign"
+        assert (out / "design_frontier.csv").exists()
+        assert (out / "design_population.csv").exists()
+        assert (out / "run_health.mydesign.json").exists()
+        assert (tmp_path / "spool" / "done" / "mydesign.json").exists()
+        metrics = json.loads(
+            (tmp_path / "spool" / "service_metrics.json").read_text())
+        assert metrics["requests"]["completed"] == 1
+        assert metrics["design"]["requests"] == 1

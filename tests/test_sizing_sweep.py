@@ -73,6 +73,45 @@ def test_sweep_rejects_sizing_cases(case):
         sizing_sweep(c, [500], [0])
 
 
+def _synthetic_week_case():
+    from dervet_tpu.benchlib import synthetic_case
+    c = synthetic_case()
+    c.scenario["allow_partial_year"] = True
+    c.datasets.time_series = c.datasets.time_series.iloc[: 24 * 3]
+    return c
+
+
+def test_sweep_dedupes_and_sorts_duplicate_candidates():
+    """Duplicate (kW, kWh) pairs used to solve twice and could make
+    ``best`` tie-dependent on grid order; the shim deduplicates and
+    sorts before solving.  Synthetic case: no reference data needed."""
+    out = sizing_sweep(_synthetic_week_case(),
+                       [1000, 500, 500, 1000], [1000, 4000, 1000])
+    # 2 distinct kW x 2 distinct kWh -> 4 rows, sorted, no duplicates
+    pairs = list(zip(out["kW"], out["kWh"]))
+    assert pairs == [(500.0, 1000.0), (500.0, 4000.0),
+                     (1000.0, 1000.0), (1000.0, 4000.0)]
+    assert out.converged.all()
+    # legacy column surface preserved by the design-engine shim
+    assert list(out.columns) == ["kW", "kWh", "operating_value", "capex",
+                                 "total", "converged", "lifetime_npv"]
+    assert np.isfinite(out["total"]).all()
+
+
+def test_sweep_order_invariant():
+    """The same grid in a different order returns the same surface (the
+    dedupe/sort contract: the winner can never be tie-dependent)."""
+    a = sizing_sweep(_synthetic_week_case(), [500, 1000], [1000, 4000])
+    b = sizing_sweep(_synthetic_week_case(), [1000, 500], [4000, 1000])
+    assert list(zip(a["kW"], a["kWh"])) == list(zip(b["kW"], b["kWh"]))
+    best_a = a.loc[a["total"].idxmin()]
+    best_b = b.loc[b["total"].idxmin()]
+    assert (best_a["kW"], best_a["kWh"]) == (best_b["kW"], best_b["kWh"])
+    scale = max(1.0, abs(float(best_a["total"])))
+    assert abs(float(best_a["total"])
+               - float(best_b["total"])) / scale < 1e-6
+
+
 def test_sweep_hard_errors_on_binary_formulation():
     """binary=1 + sizing sweep is a hard error, matching the reference's
     binary+sizing prohibition (MicrogridPOI.py:132-147) — the former
