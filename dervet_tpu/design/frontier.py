@@ -333,6 +333,18 @@ def run_design(case, spec: DesignSpec, *, backend: str = "jax",
     spec.validate()
     t0 = time.monotonic()
     candidates = generate_population(spec)
+    # the screening tiers and the certified finalist tier share one
+    # warm-start SolutionMemory: tier i+1 seeds from tier i's iterates,
+    # and the finalists seed from the tightest screening iterates —
+    # every seeded solve still runs full convergence criteria (and the
+    # finalists full certification), so a screening seed can only save
+    # iterations, never leak a screening answer into the frontier
+    caches = caches if caches is not None else ScreeningCaches(
+        pad_grid=(backend != "cpu"))
+    if final_cache is None and certify:
+        from ..scenario.scenario import SolverCache
+        final_cache = SolverCache(pad_grid=(backend != "cpu"),
+                                  memory=caches.memory)
     report = screen_candidates(
         case, candidates, backend=backend, base_opts=solver_opts,
         screen_opts_override=screen_opts_override, caches=caches,

@@ -63,11 +63,28 @@ def screening_options(base: Optional[PDHGOptions], tier: int
 class ScreeningCaches:
     """Per-tier persistent :class:`SolverCache` set.  One instance lives
     on the design service across requests, so a warm service screens
-    with zero XLA compiles; the one-shot engine builds a throwaway."""
+    with zero XLA compiles; the one-shot engine builds a throwaway.
 
-    def __init__(self, pad_grid: bool = True):
+    Warm starts: every tier's cache shares ONE
+    :class:`~dervet_tpu.ops.warmstart.SolutionMemory` — tier i+1
+    re-screens the same candidates, so its members near-match tier i's
+    stored iterates and seed from them instead of starting cold (the
+    tolerance tag keeps a looser tier's answer from ever SUBSTITUTING
+    at a tighter tier; it can only seed).  ``memory`` injects an
+    external memory (the design service shares the certified tier's, so
+    finalists seed from the tightest screening iterates too)."""
+
+    def __init__(self, pad_grid: bool = True, warm_start: bool = True,
+                 memory=None):
         self.pad_grid = bool(pad_grid)
         self._tiers: Dict[int, SolverCache] = {}
+        if memory is not None:
+            self.memory = memory
+        elif warm_start:
+            from ..ops import warmstart as _ws
+            self.memory = _ws.SolutionMemory() if _ws.enabled() else None
+        else:
+            self.memory = None
 
     def tier(self, idx) -> SolverCache:
         """The cache for one option tier.  ``idx`` is the refinement
@@ -78,7 +95,8 @@ class ScreeningCaches:
             idx = min(int(idx), len(SCREEN_TIERS) - 1)
         cache = self._tiers.get(idx)
         if cache is None:
-            cache = self._tiers[idx] = SolverCache(pad_grid=self.pad_grid)
+            cache = self._tiers[idx] = SolverCache(pad_grid=self.pad_grid,
+                                                   memory=self.memory)
         return cache
 
     def clear(self) -> None:
@@ -90,7 +108,9 @@ class ScreeningCaches:
                 "builds": sum(c.builds for c in self._tiers.values()),
                 "hits": sum(c.hits for c in self._tiers.values()),
                 "structures_cached": sum(len(c.solvers)
-                                         for c in self._tiers.values())}
+                                         for c in self._tiers.values()),
+                "warm_start": (self.memory.snapshot()
+                               if self.memory is not None else None)}
 
 
 @dataclasses.dataclass

@@ -636,18 +636,26 @@ class SolveStats:
 
 
 def fetch_result_host(res: PDHGResult,
-                      stats: Optional[SolveStats] = None) -> tuple:
+                      stats: Optional[SolveStats] = None,
+                      want_y: bool = False) -> tuple:
     """ONE fused device->host fetch of everything downstream consumes —
-    ``(x, obj, converged, iters, prim_res, gap, status)`` as numpy.
+    ``(x, obj, converged, iters, prim_res, gap, status)`` as numpy,
+    with ``y`` appended as an eighth element when ``want_y`` is set.
 
-    The dual block ``y`` is deliberately NOT fetched: it only leaves the
-    device when an infeasibility certificate needs it.  Fetching the
+    The dual block ``y`` is deliberately NOT fetched by default: it only
+    leaves the device when an infeasibility certificate, the dual-side
+    certification policy, or the warm-start memory (which stores
+    converged ``(x, y)`` pairs as seeds) needs it — and then it rides
+    the SAME fused fetch rather than a second round trip.  Fetching the
     fields one ``np.asarray`` at a time paid a full host<->device round
     trip per field (~100 ms latency each on remote backends) — seven
     latencies per group where one suffices (VERDICT r5 #1)."""
     t0 = time.perf_counter()
-    host = jax.device_get((res.x, res.obj, res.converged, res.iters,
-                           res.prim_res, res.gap, res.status))
+    fields = (res.x, res.obj, res.converged, res.iters,
+              res.prim_res, res.gap, res.status)
+    if want_y:
+        fields = fields + (res.y,)
+    host = jax.device_get(fields)
     if stats is not None:
         stats.result_fetch_s += time.perf_counter() - t0
         stats.result_bytes += sum(np.asarray(a).nbytes for a in host)
@@ -842,15 +850,33 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int, axis=None):
                     omega0=omega0, omega_lo=omega0 / 50.0,
                     omega_hi=omega0 * 50.0)
 
-    def init_state(op, c, q, l, u, dr, dc):
+    def init_state(op, c, q, l, u, dr, dc, x0=None, y0=None):
+        """Initial solver state.  ``x0``/``y0`` (UNSCALED warm-start
+        seeds, see ops/warmstart.py) override the cold start: the seed
+        is mapped into the scaled space, CLIPPED into the scaled box (a
+        stale seed may sit outside the current instance's bounds), the
+        dual seed re-projected onto its sign cone, and the adaptive-
+        restart anchors are reset to the seed itself — the restart
+        machinery starts FROM the seed, not from it plus a phantom
+        history (``mu_restart``/``mu_prev`` stay at the cold-start
+        sentinel).  A zero seed reproduces the cold start bit for bit
+        (``clip(0 / dc) == clip(0)``)."""
         t = _context(op, c, q, l, u, dr, dc)
         dtype = t["dtype"]
         fzero = t["fzero"]
         izero = fzero.astype(jnp.int32)
         bfalse = fzero > 1.0
-        # start at the projection of 0 onto the box, in the scaled space
-        x0 = jnp.clip(jnp.zeros(n, dtype) + fzero, t["l_s"], t["u_s"])
-        y0 = jnp.zeros(m, dtype) + fzero
+        if x0 is None:
+            # start at the projection of 0 onto the box, in scaled space
+            x0 = jnp.clip(jnp.zeros(n, dtype) + fzero, t["l_s"], t["u_s"])
+        else:
+            x0 = jnp.clip(x0.astype(dtype) / dc + fzero,
+                          t["l_s"], t["u_s"])
+        if y0 is None:
+            y0 = jnp.zeros(m, dtype) + fzero
+        else:
+            y0 = y0.astype(dtype) / dr + fzero
+            y0 = jnp.where(t["eq_mask"], y0, jnp.maximum(y0, 0.0))
         big = jnp.asarray(jnp.finfo(dtype).max, dtype) / 2 + fzero
         return _State(
             x=x0, y=y0,
@@ -1167,6 +1193,12 @@ class CompiledLPSolver:
         self._jit_fin = jax.jit(self._solve.finalize)
         self._jit_init_b = jax.jit(jax.vmap(self._solve.init_state,
                                             in_axes=data_axes))
+        # warm-start variant: per-member unscaled seeds batched on the
+        # leading axis.  A separate program (vmap axes are static), so a
+        # cold service never pays its compile; its first use in a warm
+        # round is an honestly-counted compile event ("init_seeded").
+        self._jit_init_b_seed = jax.jit(jax.vmap(self._solve.init_state,
+                                                 in_axes=data_axes + (0, 0)))
         self._jit_chunk_b = jax.jit(jax.vmap(self._solve.run_chunk,
                                              in_axes=data_axes + (None, 0, None)),
                                     compiler_options=pallas_compiler_options(
@@ -1231,7 +1263,8 @@ class CompiledLPSolver:
         return tuple(jnp.asarray(a) for a in arrs)
 
     def solve(self, c=None, q=None, l=None, u=None,
-              stats: Optional[SolveStats] = None) -> PDHGResult:
+              stats: Optional[SolveStats] = None,
+              x0=None, y0=None) -> PDHGResult:
         # the build-time presolve clamp (LPBuilder.build) tightened 'ge'
         # rhs against the build-time box [l, u]; per-instance bounds that
         # WIDEN that box while q defaults would let a clamped row bind
@@ -1262,8 +1295,10 @@ class CompiledLPSolver:
         # _drive so concurrent solves cannot cross-wire their counters
         stats = stats if stats is not None else SolveStats()
         c, q, l, u = self._data(c, q, l, u, stats)
+        x0, y0 = self._seed_data(x0, y0, stats)
         if all(arr.ndim == 1 for arr in (c, q, l, u)):
-            return self._drive(c, q, l, u, batched=False, stats=stats)
+            return self._drive(c, q, l, u, batched=False, stats=stats,
+                               x0=x0, y0=y0)
         if any(arr.ndim not in (1, 2) for arr in (c, q, l, u)):
             raise ValueError("solve() inputs must be 1-D (shared) or 2-D (batched)")
         sizes = {arr.shape[0] for arr in (c, q, l, u) if arr.ndim == 2}
@@ -1271,17 +1306,52 @@ class CompiledLPSolver:
             raise ValueError(f"inconsistent batch sizes in solve(): {sorted(sizes)}")
         B = sizes.pop()
         c, q, l, u = self.batch_data(B, c, q, l, u)
-        return self._drive(c, q, l, u, batched=True, stats=stats)
+        if x0 is not None:
+            x0 = jnp.broadcast_to(x0, (B, self.lp.n)) if x0.ndim == 1 else x0
+            y0 = jnp.broadcast_to(y0, (B, self.lp.m)) if y0.ndim == 1 else y0
+            if x0.shape[0] != B or y0.shape[0] != B:
+                raise ValueError(
+                    f"warm-start seed batch {x0.shape[0]}/{y0.shape[0]} "
+                    f"does not match the data batch {B}")
+        return self._drive(c, q, l, u, batched=True, stats=stats,
+                           x0=x0, y0=y0)
+
+    def _seed_data(self, x0, y0, stats: Optional[SolveStats] = None):
+        """Host-cast + single ``device_put`` for the warm-start seeds
+        (both-or-neither; a missing dual seed defaults to zeros, which
+        reproduces the cold dual start exactly)."""
+        if x0 is None and y0 is None:
+            return None, None
+        if x0 is None:
+            raise ValueError("warm start needs x0 when y0 is given")
+        if y0 is None:
+            y0 = np.zeros(np.shape(x0)[:-1] + (self.lp.m,))
+        arrs = [x0, y0]
+        host_idx = [i for i, a in enumerate(arrs)
+                    if not isinstance(a, jax.Array)]
+        if host_idx:
+            host = tuple(_hcast(arrs[i], self.opts.dtype) for i in host_idx)
+            t0 = time.perf_counter()
+            put = jax.device_put(host)
+            if stats is not None:
+                stats.h2d_s += time.perf_counter() - t0
+                stats.h2d_transfers += len(host)
+                stats.h2d_bytes += sum(a.nbytes for a in host)
+            for i, v in zip(host_idx, put):
+                arrs[i] = v
+        return tuple(jnp.asarray(a) for a in arrs)
 
     def _drive(self, c, q, l, u, batched: bool,
-               stats: Optional[SolveStats] = None) -> PDHGResult:
+               stats: Optional[SolveStats] = None,
+               x0=None, y0=None) -> PDHGResult:
         """Fallback wrapper: if the fused Pallas chunk cannot compile on
         this backend, disable it process-wide and retry on the XLA scan
         path."""
         with self._solve_lock:   # one in-flight solve per solver (ADVICE r4)
             self.last_stats = stats     # under the lock: no cross-wiring
             try:
-                return self._drive_inner(c, q, l, u, batched, stats)
+                return self._drive_inner(c, q, l, u, batched, stats,
+                                         x0=x0, y0=y0)
             except Exception as e:
                 from . import pallas_chunk
                 # ignore_runtime_disabled: the failing program was TRACED
@@ -1301,20 +1371,28 @@ class CompiledLPSolver:
                 # fresh jits = fresh XLA programs: reset the compile-event
                 # tracking so the retry's compiles are counted honestly
                 self._exec_shapes.clear()
-                return self._drive_inner(c, q, l, u, batched, stats)
+                return self._drive_inner(c, q, l, u, batched, stats,
+                                         x0=x0, y0=y0)
 
     def _drive_inner(self, c, q, l, u, batched: bool,
-                     stats: Optional[SolveStats] = None) -> PDHGResult:
+                     stats: Optional[SolveStats] = None,
+                     x0=None, y0=None) -> PDHGResult:
         """Host-chunked driver: bounded device calls until every instance
         converges, certifies infeasibility, or hits max_iters.  Keeps a
         single XLA program short (runtime watchdogs kill multi-minute
-        device steps) and gives chunk-level progress."""
-        init = self._jit_init_b if batched else self._jit_init
+        device steps) and gives chunk-level progress.  ``x0``/``y0``
+        (unscaled warm-start seeds) route through the seeded init
+        program; everything downstream is seed-agnostic."""
         chunk = self._jit_chunk_b if batched else self._jit_chunk
         fin = self._jit_fin_b if batched else self._jit_fin
         args = (self.op, c, q, l, u, self.dr, self.dc)
-        self._note_exec("init", c.shape, stats)
-        state = init(*args)
+        if x0 is not None:
+            self._note_exec("init_seeded", c.shape, stats)
+            state = (self._jit_init_b_seed(*args, x0, y0) if batched
+                     else self._jit_init(*args, x0, y0))
+        else:
+            self._note_exec("init", c.shape, stats)
+            state = (self._jit_init_b if batched else self._jit_init)(*args)
         if stats is not None:
             stats.dispatches += 1
         max_iters = self.opts.max_iters

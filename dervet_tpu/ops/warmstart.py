@@ -1,0 +1,436 @@
+"""Warm-start solution memory: seed PDHG from nearby converged iterates.
+
+BENCH_r05 puts the hot-path cost squarely on iteration count (iters p50
+1664 / p99 2176 per window LP at 0.26% FLOPs utilization), and the
+PDLP-family literature (PAPERS.md: MPAX, arxiv 2412.09734; PDHG-unrolled
+L2O, arxiv 2406.01908) shows that seeding PDHG from a nearby converged
+iterate cuts iteration counts 2-10x on structure-identical LP families —
+exactly the serving workload, where ``SolverCache`` already fingerprints
+structure-identical windows across requests.
+
+This module is the memory half of that design: a bounded LRU
+:class:`SolutionMemory` that a long-lived :class:`~dervet_tpu.scenario.
+scenario.SolverCache` carries across dispatches, storing converged
+UNSCALED ``(x, y)`` iterates per LP structure key.  Two lookup grades:
+
+* **exact** — the member's ``(c, q, l, u)`` bytes AND solver-tolerance
+  tag match a stored entry (a repeat request, a re-screened candidate at
+  the same tier).  The stored solution is re-verified against the FULL
+  convergence criteria — a float64 host replica of the solver's own KKT
+  test, plus a bounds-box check the device never needs (its iterates are
+  clipped by construction) — and, if it passes, shipped verbatim with
+  zero device work and ``iters == 0``.  Because the stored vector is the
+  byte-exact device output of the earlier solve, a warm repeat is
+  BYTE-IDENTICAL to its cold counterpart across the whole results
+  surface.  A stored solution that fails the check (stored at a looser
+  tier, marginal convergence) falls through to iterate seeding.
+* **near** — same structure, different data: the quantized-data digest
+  (float16 cast of ``(c, q, l, u)`` — the "hash of quantized data"
+  proximity key) finds numerically-near entries fast, and a small
+  bucketed-mean feature vector picks the nearest entry by L2 distance
+  otherwise.  The entry seeds the solver's iterates via
+  ``init_state(..., x0=, y0=)`` — clipped into the scaled box, restart
+  anchors reset — and the solve runs its normal convergence criteria
+  from there.
+
+Safety argument: a warm-started window still runs full convergence
+criteria and full PR-4 float64 certification, so a stale, evicted, or
+poisoned seed can only cost iterations, never correctness — the
+``stale_seed`` fault kind (utils/faultinject.py) drills exactly that.
+``DERVET_TPU_WARMSTART=0`` kills the whole subsystem (cold path,
+byte-identical to pre-warm-start behavior); ``DERVET_TPU_WARMSTART_CAP``
+bounds the entry count (default 512).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import threading
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+WARMSTART_ENV = "DERVET_TPU_WARMSTART"
+CAP_ENV = "DERVET_TPU_WARMSTART_CAP"
+DEFAULT_CAP = 512
+# feature vector: bucketed means per data vector — coarse but cheap, and
+# only consulted when the quantized digest misses
+FEATURE_BUCKETS = 8
+
+
+def enabled() -> bool:
+    """Live kill switch: read per call so a test (or an operator mid-
+    incident) can force the cold path without rebuilding services."""
+    return os.environ.get(WARMSTART_ENV, "1").strip().lower() \
+        not in ("0", "false", "off")
+
+
+def memory_cap() -> int:
+    try:
+        return max(1, int(os.environ.get(CAP_ENV, DEFAULT_CAP)))
+    except ValueError:
+        return DEFAULT_CAP
+
+
+def opts_tag(opts) -> tuple:
+    """The tolerance regime a solution converged under.  Exact-match
+    substitution requires the SAME tag: a loose screening-tier answer
+    must never substitute for a certified-tier solve on digest equality
+    alone (it still serves as an iterate seed — the near path).  The
+    solver dtype is part of the tag — it also sets the resolution the
+    exact data digest is taken at, so two dtype regimes can never
+    cross-substitute."""
+    return (float(opts.eps_abs), float(opts.eps_rel),
+            int(opts.max_iters), float(opts.inaccurate_factor),
+            str(np.dtype(opts.dtype)))
+
+
+def tag_dtype(tag: tuple) -> np.dtype:
+    """The solver dtype a tag was built with (the exact-digest
+    resolution)."""
+    return np.dtype(tag[4])
+
+
+def data_digest(lp, dtype=np.float32) -> bytes:
+    """Byte-exact fingerprint of the per-instance data ``(c, q, l, u)``
+    in the solver dtype (what the device actually solves)."""
+    h = hashlib.sha256()
+    for a in (lp.c, lp.q, lp.l, lp.u):
+        h.update(np.ascontiguousarray(np.asarray(a, dtype)).tobytes())
+    return h.digest()
+
+
+def quant_digest(lp) -> bytes:
+    """Proximity key: hash of the QUANTIZED data (float16 cast, ~3
+    significant decimal digits; infinities and the reference's 1e30-ish
+    no-limit sentinels all collapse to signed inf, which is what they
+    mean).  Two instances whose data agree to quantization share the key
+    — the fast near-neighbor path."""
+    h = hashlib.sha256()
+    for a in (lp.c, lp.q, lp.l, lp.u):
+        with np.errstate(over="ignore"):
+            h.update(np.ascontiguousarray(
+                np.asarray(a, np.float64)).astype(np.float16).tobytes())
+    return h.digest()
+
+
+def feature_vec(lp) -> np.ndarray:
+    """Small signature of ``(c, q, l, u)`` for nearest-entry selection:
+    ``FEATURE_BUCKETS`` contiguous-bucket means per vector (non-finite
+    entries zeroed — sentinels would drown the signal)."""
+    parts = []
+    for a in (lp.c, lp.q, lp.l, lp.u):
+        a = np.asarray(a, np.float64)
+        a = np.where(np.isfinite(a), a, 0.0)
+        n = a.shape[0]
+        if n == 0:
+            parts.append(np.zeros(FEATURE_BUCKETS))
+            continue
+        pad = (-n) % FEATURE_BUCKETS
+        if pad:
+            a = np.concatenate([a, np.zeros(pad)])
+        parts.append(a.reshape(FEATURE_BUCKETS, -1).mean(axis=1))
+    return np.concatenate(parts)
+
+
+def host_kkt(lp, x, y) -> Optional[Tuple[float, float, float,
+                                         float, float]]:
+    """Float64 host replica of the solver's KKT terms
+    (``ops.pdhg._kkt_terms``) on the UNSCALED problem, plus a bounds-box
+    feasibility term the device test omits only because its iterates
+    are box-projected by construction.  Returns
+    ``(prim, dual, gap, pobj, dobj)`` — or None for malformed vectors."""
+    x = np.asarray(x, np.float64)
+    y = np.asarray(y, np.float64)
+    if x.shape != (lp.n,) or y.shape != (lp.m,):
+        return None
+    if not (np.all(np.isfinite(x)) and np.all(np.isfinite(y))):
+        return None
+    r = lp.q - lp.K @ x
+    eq = np.arange(lp.m) < lp.n_eq
+    viol = np.where(eq, np.abs(r), np.maximum(r, 0.0))
+    l_fin = np.isfinite(lp.l)
+    u_fin = np.isfinite(lp.u)
+    # box violations fold into the primal residual (the stricter-only
+    # direction: a genuine device iterate has none)
+    bviol = (np.where(l_fin, np.maximum(lp.l - x, 0.0), 0.0)
+             + np.where(u_fin, np.maximum(x - lp.u, 0.0), 0.0))
+    prim = float(np.sqrt(np.sum(viol * viol) + np.sum(bviol * bviol)))
+    lam = lp.c - lp.K.T @ y
+    lam_pos = np.maximum(lam, 0.0)
+    lam_neg = np.minimum(lam, 0.0)
+    dres = np.where(l_fin, 0.0, lam_pos) + np.where(u_fin, 0.0, -lam_neg)
+    dual = float(np.linalg.norm(dres)) if dres.size else 0.0
+    pobj = float(lp.c @ x)
+    dobj = float(lp.q @ y
+                 + np.sum(np.where(l_fin, lam_pos * lp.l, 0.0)
+                          + np.where(u_fin, lam_neg * lp.u, 0.0)))
+    return prim, dual, abs(pobj - dobj), pobj, dobj
+
+
+def check_converged_host(lp, x, y, opts, factor: float = 1.0) -> bool:
+    """Does ``(x, y)`` satisfy the solver's full convergence criteria
+    (``ops.pdhg._converged``) at ``factor``x the tolerances, evaluated
+    in float64 on the unscaled problem?  ``factor=1`` is the strict
+    gate exact-match substitution requires; ``factor=
+    opts.inaccurate_factor`` is the INACCURATE acceptance band the cold
+    path already ships (with a warning)."""
+    terms = host_kkt(lp, x, y)
+    if terms is None:
+        return False
+    prim, dual, gap, pobj, dobj = terms
+    eps_abs = opts.eps_abs * factor
+    eps_rel = opts.eps_rel * factor
+    q_norm = float(np.linalg.norm(lp.q))
+    c_norm = float(np.linalg.norm(lp.c))
+    return (prim <= eps_abs + eps_rel * q_norm
+            and dual <= eps_abs + eps_rel * c_norm
+            and gap <= eps_abs + eps_rel * (abs(pobj) + abs(dobj)))
+
+
+@dataclasses.dataclass
+class SeedEntry:
+    """One stored converged iterate (UNSCALED, solver dtype, trimmed —
+    bucket-grid padding rows are never stored)."""
+    x: np.ndarray
+    y: np.ndarray
+    obj: float
+    feature: np.ndarray
+    tag: tuple
+    exact: bytes
+    quant: bytes
+
+
+@dataclasses.dataclass
+class MemberPlan:
+    """One group member's warm-start decision."""
+    kind: str                        # "cold" | "near" | "exact"
+    entry: Optional[SeedEntry] = None
+    substituted: bool = False        # exact hit that passed the f64 check
+    stale_fault: bool = False        # seed corrupted by fault injection
+    # substitution verdict + residuals (the INACCURATE band re-ships the
+    # cold path's accepted-with-a-warning answer, warning included)
+    inaccurate: bool = False
+    prim: float = 0.0
+    gap: float = 0.0
+    # this member's OWN data digests from the probe, so a post-solve
+    # store skips recomputing them
+    exact_digest: Optional[bytes] = None
+    quant_digest: Optional[bytes] = None
+
+
+class SolutionMemory:
+    """Bounded LRU of converged ``(x, y)`` iterates keyed by
+    ``(structure key, exact data digest, tolerance tag)``.
+
+    Thread-safe: the dispatch pipeline's workers look up and store
+    concurrently.  Secondary indices serve the two proximity grades —
+    ``(structure, quant digest) -> most recent entry`` and
+    ``structure -> live entries`` for the nearest-by-feature fallback.
+    A per-structure rolling window of COLD iteration counts provides the
+    baseline the solve ledger's ``iters_saved`` is measured against."""
+
+    def __init__(self, max_entries: Optional[int] = None):
+        self.max_entries = int(max_entries) if max_entries else memory_cap()
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, SeedEntry]" = OrderedDict()
+        self._by_struct: Dict[object, Dict[tuple, SeedEntry]] = {}
+        self._by_quant: Dict[tuple, tuple] = {}
+        self._cold_iters: Dict[object, deque] = {}
+        self.stats = {"stores": 0, "evictions": 0, "hits_exact": 0,
+                      "hits_near": 0, "misses": 0, "substituted": 0,
+                      "stale_seed_faults": 0, "invalidated": 0}
+
+    # -- internals (caller holds the lock) ------------------------------
+    def _unlink(self, key, entry) -> None:
+        """Remove one (already popped) entry from the secondary indices
+        — the single place the index relationship lives, shared by
+        eviction and invalidation."""
+        skey = key[0]
+        pool = self._by_struct.get(skey)
+        if pool is not None:
+            pool.pop(key, None)
+            if not pool:
+                del self._by_struct[skey]
+        qkey = (skey, entry.quant)
+        if self._by_quant.get(qkey) == key:
+            del self._by_quant[qkey]
+
+    def _evict_lru(self) -> None:
+        while len(self._entries) > self.max_entries:
+            key, entry = self._entries.popitem(last=False)
+            self._unlink(key, entry)
+            self.stats["evictions"] += 1
+
+    def bump(self, stat: str, n: int = 1) -> None:
+        """Locked counter increment for planner-side events."""
+        with self._lock:
+            self.stats[stat] = self.stats.get(stat, 0) + n
+
+    # -- public API -----------------------------------------------------
+    def lookup(self, skey, lp, tag: tuple
+               ) -> Tuple[Optional[SeedEntry], Optional[str]]:
+        """The best stored seed for one member: ``(entry, "exact")`` on a
+        byte-exact data + tag match, ``(entry, "near")`` via the
+        quantized digest or the nearest feature vector, ``(None, None)``
+        when this structure has no entries."""
+        entry, kind, _, _ = self.probe(skey, lp, tag)
+        return entry, kind
+
+    def probe(self, skey, lp, tag: tuple):
+        """`lookup` plus the member's own ``(exact, quant)`` digests, so
+        a later ``store`` of this member's solution skips recomputing
+        the sha256 passes (~ms each at year-LP sizes).  The exact
+        digest is taken at the tag's solver dtype — the resolution the
+        device actually solves at."""
+        exact = data_digest(lp, tag_dtype(tag))
+        quant = quant_digest(lp)
+        with self._lock:
+            key = (skey, exact, tag)
+            e = self._entries.get(key)
+            if e is not None:
+                self._entries.move_to_end(key)
+                self.stats["hits_exact"] += 1
+                return e, "exact", exact, quant
+            qk = self._by_quant.get((skey, quant))
+            if qk is not None:
+                e = self._entries.get(qk)
+                if e is not None:
+                    self._entries.move_to_end(qk)
+                    self.stats["hits_near"] += 1
+                    return e, "near", exact, quant
+            pool = self._by_struct.get(skey)
+            if pool:
+                f = feature_vec(lp)
+                best_key = min(
+                    pool, key=lambda k: float(
+                        np.linalg.norm(pool[k].feature - f)))
+                self._entries.move_to_end(best_key)
+                self.stats["hits_near"] += 1
+                return pool[best_key], "near", exact, quant
+            self.stats["misses"] += 1
+            return None, None, exact, quant
+
+    def store(self, skey, lp, tag: tuple, x, y, obj: float,
+              exact: Optional[bytes] = None,
+              quant: Optional[bytes] = None) -> None:
+        """Store one converged member's unscaled iterates (trimmed).
+        ``exact``/``quant`` pass through the digests a prior ``probe``
+        of the same member already computed."""
+        entry = SeedEntry(
+            x=np.array(x, copy=True), y=np.array(y, copy=True),
+            obj=float(obj), feature=feature_vec(lp), tag=tuple(tag),
+            exact=(exact if exact is not None
+                   else data_digest(lp, tag_dtype(tag))),
+            quant=quant if quant is not None else quant_digest(lp))
+        key = (skey, entry.exact, entry.tag)
+        with self._lock:
+            if key in self._entries:
+                del self._entries[key]
+            self._entries[key] = entry
+            self._by_struct.setdefault(skey, {})[key] = entry
+            self._by_quant[(skey, entry.quant)] = key
+            self.stats["stores"] += 1
+            self._evict_lru()
+
+    def invalidate(self, skey, lp, dtype=np.float32) -> int:
+        """Drop every entry for this structure whose data digest (at
+        ``dtype``, the rejected regime's solver dtype) matches ``lp``
+        — any tolerance tag.  Called when the PR-4 certifier REJECTS a
+        solution the memory vouched for — without this, a
+        wrong-but-convergence-passing entry would be re-substituted,
+        re-rejected, and re-escalated on every exact repeat forever
+        (each hit even refreshing it against LRU eviction).  Returns the
+        number of entries dropped."""
+        exact = data_digest(lp, dtype)
+        with self._lock:
+            doomed = [k for k in self._entries
+                      if k[0] == skey and k[1] == exact]
+            for key in doomed:
+                self._unlink(key, self._entries.pop(key))
+            self.stats["invalidated"] += len(doomed)
+            return len(doomed)
+
+    def note_cold_iters(self, skey, iters) -> None:
+        """Record cold members' iteration counts — the per-structure
+        baseline ``iters_saved`` is measured against."""
+        with self._lock:
+            d = self._cold_iters.setdefault(skey, deque(maxlen=512))
+            d.extend(int(v) for v in np.atleast_1d(iters))
+
+    def cold_p50(self, skey) -> Optional[int]:
+        with self._lock:
+            d = self._cold_iters.get(skey)
+            return int(np.percentile(list(d), 50)) if d else None
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "structures": len(self._by_struct),
+                    "max_entries": self.max_entries,
+                    "bytes": int(sum(e.x.nbytes + e.y.nbytes
+                                     for e in self._entries.values())),
+                    **dict(self.stats)}
+
+
+def plan_group(memory: SolutionMemory, skey, lps, opts, labels
+               ) -> List[MemberPlan]:
+    """Per-member warm-start plan for one structure group.
+
+    Exact hits are promoted to substitution only after the stored
+    solution passes :func:`check_converged_host` under the CURRENT
+    options; the ``stale_seed`` fault corrupts a targeted member's seed
+    COPY and demotes it to iterate seeding — the production shape of a
+    stale/evicted/poisoned entry, which may cost iterations but is
+    always caught by the normal convergence criteria."""
+    from ..utils import faultinject
+    tag = opts_tag(opts)
+    plans: List[MemberPlan] = []
+    fplan = faultinject.get_plan()
+    for lp, label in zip(lps, labels):
+        entry, kind, exact, quant = memory.probe(skey, lp, tag)
+        if entry is None:
+            plans.append(MemberPlan("cold", exact_digest=exact,
+                                    quant_digest=quant))
+            continue
+        if fplan is not None and fplan.stale_seed_due(label):
+            bad_x = faultinject.corrupt_array(
+                entry.x, f"stale_seed|{label}", fplan.stale_seed_scale)
+            bad_y = faultinject.corrupt_array(
+                entry.y, f"stale_seed|y|{label}", fplan.stale_seed_scale)
+            stale = SeedEntry(x=bad_x, y=bad_y, obj=entry.obj,
+                              feature=entry.feature, tag=entry.tag,
+                              exact=b"", quant=b"")
+            memory.bump("stale_seed_faults")
+            plans.append(MemberPlan("near", stale, stale_fault=True,
+                                    exact_digest=exact,
+                                    quant_digest=quant))
+            continue
+        mp = MemberPlan(kind, entry, exact_digest=exact,
+                        quant_digest=quant)
+        if kind == "exact":
+            terms = host_kkt(lp, entry.x, entry.y)
+            if terms is not None:
+                strict = check_converged_host(lp, entry.x, entry.y, opts)
+                loose = strict or check_converged_host(
+                    lp, entry.x, entry.y, opts,
+                    factor=opts.inaccurate_factor)
+                if loose:
+                    # re-ship the stored answer under the float64
+                    # re-check's OWN verdict: CONVERGED inside
+                    # tolerance, INACCURATE (accepted upstream with a
+                    # warning) inside the inaccurate band.  On a
+                    # marginal window the f64 grading (plus the box
+                    # term) can land stricter than the device's f32
+                    # verdict did — the warm repeat then carries the
+                    # warning the cold pass skipped, or re-solves; the
+                    # divergence is one-directional (stricter) and the
+                    # shipped bytes, when substituted, are identical.
+                    mp.substituted = True
+                    mp.inaccurate = not strict
+                    mp.prim, mp.gap = terms[0], terms[2]
+                    memory.bump("substituted")
+        plans.append(mp)
+    return plans

@@ -71,13 +71,16 @@ def scenario_mesh(n_devices: Optional[int] = None) -> Mesh:
 
 
 def solve_batch_sharded(solver: CompiledLPSolver, mesh: Mesh,
-                        c=None, q=None, l=None, u=None, stats=None):
+                        c=None, q=None, l=None, u=None, stats=None,
+                        x0=None, y0=None):
     """Solve a batch of LP instances sharded over ``mesh``.
 
     Any of ``c/q/l/u`` may be 1-D (shared, replicated) or 2-D batched on the
     leading axis.  The batch is padded up to a multiple of the mesh size
     (padding rows replicate the last row) and trimmed from the result;
-    padding rows are masked out of the psum'd statistics.
+    padding rows are masked out of the psum'd statistics.  ``x0``/``y0``
+    (optional UNSCALED warm-start seeds, batched like the data) route
+    through the seeded init program — sharded on the same axis.
 
     Returns ``(PDHGResult, ShardedStats)`` with result arrays batched on the
     original (un-padded) leading axis.
@@ -96,7 +99,7 @@ def solve_batch_sharded(solver: CompiledLPSolver, mesh: Mesh,
     with solver._solve_lock:
         try:
             return _solve_batch_sharded_inner(solver, mesh, c, q, l, u,
-                                              stats)
+                                              stats, x0=x0, y0=y0)
         except Exception as e:
             from ..ops import pallas_chunk
             kernel_in_play = (solver.opts.pallas_chunk
@@ -113,11 +116,12 @@ def solve_batch_sharded(solver: CompiledLPSolver, mesh: Mesh,
             # fresh jits = fresh XLA programs: reset compile-event tracking
             solver._exec_shapes.clear()
             return _solve_batch_sharded_inner(solver, mesh, c, q, l, u,
-                                              stats)
+                                              stats, x0=x0, y0=y0)
 
 
 def _solve_batch_sharded_inner(solver: CompiledLPSolver, mesh: Mesh,
-                               c=None, q=None, l=None, u=None, stats=None):
+                               c=None, q=None, l=None, u=None, stats=None,
+                               x0=None, y0=None):
     import time
 
     from ..ops.pdhg import SolveStats
@@ -136,12 +140,19 @@ def _solve_batch_sharded_inner(solver: CompiledLPSolver, mesh: Mesh,
         raise ValueError(f"inconsistent batch sizes: {sorted(sizes)}")
     B = sizes.pop()
     c, q, l, u = solver.batch_data(B, c, q, l, u)
+    x0, y0 = solver._seed_data(x0, y0, stats)
+    if x0 is not None:
+        x0 = jnp.broadcast_to(x0, (B, solver.lp.n)) if x0.ndim == 1 else x0
+        y0 = jnp.broadcast_to(y0, (B, solver.lp.m)) if y0.ndim == 1 else y0
 
     n_dev = mesh.devices.size
     B_pad = ((B + n_dev - 1) // n_dev) * n_dev
     if B_pad != B:
         c, q, l, u = (jnp.pad(a, [(0, B_pad - B)] + [(0, 0)] * (a.ndim - 1),
                               mode="edge") for a in (c, q, l, u))
+        if x0 is not None:
+            x0, y0 = (jnp.pad(a, [(0, B_pad - B), (0, 0)], mode="edge")
+                      for a in (x0, y0))
 
     valid = (jnp.arange(B_pad) < B).astype(jnp.int32)
 
@@ -151,6 +162,8 @@ def _solve_batch_sharded_inner(solver: CompiledLPSolver, mesh: Mesh,
     # chunk-level progress), not one multi-minute XLA program
     vinit = jax.vmap(solver._solve.init_state,
                      in_axes=(None, 0, 0, 0, 0, None, None))
+    vinit_seed = jax.vmap(solver._solve.init_state,
+                          in_axes=(None, 0, 0, 0, 0, None, None, 0, 0))
     vchunk = jax.vmap(solver._solve.run_chunk,
                       in_axes=(None, 0, 0, 0, 0, None, None, None, 0, None))
     vfin = jax.vmap(solver._solve.finalize,
@@ -158,6 +171,10 @@ def _solve_batch_sharded_inner(solver: CompiledLPSolver, mesh: Mesh,
 
     def local_init(c, q, l, u):
         return vinit(solver.op, c, q, l, u, solver.dr, solver.dc)
+
+    def local_init_seed(c, q, l, u, x0, y0):
+        return vinit_seed(solver.op, c, q, l, u, solver.dr, solver.dc,
+                          x0, y0)
 
     def local_chunk(c, q, l, u, state, limit):
         return vchunk(solver.op, c, q, l, u, solver.dr, solver.dc,
@@ -179,6 +196,9 @@ def _solve_batch_sharded_inner(solver: CompiledLPSolver, mesh: Mesh,
                            prim_res=P(AXIS), gap=P(AXIS), status=P(AXIS))
     sh_init = jax.jit(shard_map(
         local_init, mesh=mesh, in_specs=(P(AXIS),) * 4, out_specs=P(AXIS)))
+    sh_init_seed = jax.jit(shard_map(
+        local_init_seed, mesh=mesh, in_specs=(P(AXIS),) * 6,
+        out_specs=P(AXIS)))
     from ..ops.pdhg import pallas_compiler_options
     sh_chunk = jax.jit(shard_map(
         local_chunk, mesh=mesh,
@@ -190,8 +210,12 @@ def _solve_batch_sharded_inner(solver: CompiledLPSolver, mesh: Mesh,
                                            max_prim_res=P()))))
 
     opts = solver.opts
-    solver._note_exec("sh_init", c.shape, stats)
-    state = sh_init(c, q, l, u)
+    if x0 is not None:
+        solver._note_exec("sh_init_seeded", c.shape, stats)
+        state = sh_init_seed(c, q, l, u, x0, y0)
+    else:
+        solver._note_exec("sh_init", c.shape, stats)
+        state = sh_init(c, q, l, u)
     stats.dispatches += 1
     total = 0
     while True:

@@ -597,4 +597,11 @@ class BatchRound:
                 1 for g in initial if len(g.get("requests") or ()) > 1),
             "compile_events": int(
                 (led.get("totals") or {}).get("compile_events", 0)),
+            # warm-start observables (ops/warmstart.py): how many of the
+            # round's windows rode a seed, and how many repeat windows
+            # shipped a re-verified stored solution with zero device work
+            "seeded_windows": int(
+                (led.get("warm_start") or {}).get("seeded", 0)),
+            "substituted_windows": int(
+                (led.get("warm_start") or {}).get("substituted", 0)),
         }

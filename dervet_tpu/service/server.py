@@ -93,7 +93,13 @@ class ScenarioService:
         # across rounds (see run_dispatch's solver_cache hook), and
         # pad_grid snaps every coalesced batch onto the pdhg compaction
         # bucket widths so varying request mixes reuse compiled shapes
-        self.solver_cache = SolverCache(pad_grid=(backend != "cpu"))
+        # warm_start: the cache carries a SolutionMemory across rounds,
+        # so repeat/nearby requests seed PDHG from stored converged
+        # iterates (exact repeats re-verify + ship the stored solution
+        # with zero device work) — see ops/warmstart.py; every seeded
+        # window still runs full convergence criteria + certification
+        self.solver_cache = SolverCache(pad_grid=(backend != "cpu"),
+                                        warm_start=True)
         # -- self-healing layer (see service/resilience.py) ------------
         # circuit breakers around the escalation-ladder rungs, the
         # certification path, and the backend as a whole; thresholds are
@@ -117,12 +123,24 @@ class ScenarioService:
         # the degraded tier gets its OWN compiled-solver cache: a
         # screening solver (loose tolerance, short budget) must never be
         # handed to a certified-tier round sharing the structure key
-        self.degraded_cache = SolverCache(pad_grid=(backend != "cpu"))
+        # the degraded tier SHARES the warm-start memory (its screening
+        # answers make fine seeds and vice versa — the tolerance tag
+        # keeps a loose answer from ever substituting for a certified
+        # one) while keeping its own compiled-solver cache
+        self.degraded_cache = SolverCache(pad_grid=(backend != "cpu"),
+                                          memory=self.solver_cache.memory)
         # design requests (BOOST sizing): persistent per-tier screening
         # caches — a warm service screens a repeat population with zero
-        # XLA compiles; finalists ride the certified solver_cache above
+        # XLA compiles; finalists ride the certified solver_cache above.
+        # One SHARED solution memory across the tiers and the certified
+        # cache: tier i+1 re-screens the same candidates seeded from
+        # tier i's iterates, and finalists seed from the tightest
+        # screening iterates (near-grade only — substitution needs an
+        # exact tolerance-tag match)
         from ..design.screen import ScreeningCaches
-        self.design_caches = ScreeningCaches(pad_grid=(backend != "cpu"))
+        self.design_caches = ScreeningCaches(
+            pad_grid=(backend != "cpu"),
+            memory=self.solver_cache.memory)
         self._design = {"requests": 0, "candidates": 0, "screen_rounds": 0,
                         "screen_s": 0.0, "finalists": 0,
                         "degraded_answers": 0, "screen_dispatches": 0,
@@ -158,7 +176,8 @@ class ScenarioService:
                         "windows": 0, "device_groups": 0,
                         "cross_request_groups": 0, "batch_sum": 0.0,
                         "compile_events": 0, "round_s": 0.0,
-                        "preempted": 0, "degraded_rounds": 0}
+                        "preempted": 0, "degraded_rounds": 0,
+                        "seeded_windows": 0, "substituted_windows": 0}
         self._requests = {"completed": 0, "failed": 0}
         self.last_round_ledger: Optional[Dict] = None
         self.device_info: Optional[Dict] = None
@@ -484,7 +503,8 @@ class ScenarioService:
             if rnd.degraded:
                 self._rounds["degraded_rounds"] += 1
             for k in ("requests", "cases", "windows", "device_groups",
-                      "cross_request_groups", "compile_events"):
+                      "cross_request_groups", "compile_events",
+                      "seeded_windows", "substituted_windows"):
                 self._rounds[k] += int(st.get(k, 0))
             self._rounds["batch_sum"] += float(
                 st.get("mean_batch", 0.0)) * int(st.get("device_groups", 0))
@@ -618,6 +638,10 @@ class ScenarioService:
                 "structures_cached": len(cache.solvers),
                 "compile_events_total": rounds["compile_events"],
             },
+            # warm-start solution memory (ops/warmstart.py): entry
+            # counts, hit grades, substitutions, stale-seed drills
+            "warm_start": (cache.memory.snapshot()
+                           if cache.memory is not None else None),
             "service": {"backend": self.backend,
                         "started": self._started,
                         "draining": self._draining.is_set(),

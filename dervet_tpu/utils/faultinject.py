@@ -82,6 +82,20 @@ Two activation paths:
                                          fires 3 consecutive losses
                                          (default 1) — drills N-failed-
                                          re-inits -> CPU failover
+      DERVET_TPU_FAULT_STALE_SEED=1      deterministically corrupt window
+                                         1's WARM-START seed (x and y)
+                                         before the seeded solve (scale
+                                         DERVET_TPU_FAULT_STALE_SEED_SCALE,
+                                         default 0.5) — exercises the
+                                         warm-start safety contract: a
+                                         stale/poisoned seed is demoted
+                                         from exact substitution to
+                                         iterate seeding, the solve still
+                                         runs full convergence criteria
+                                         (and certification), and the
+                                         corruption can only cost
+                                         iterations, never correctness
+                                         ('all' matches every window)
       DERVET_TPU_FAULT_POISON=rid.0      poison-REQUEST crash: dispatching
                                          the targeted case raises an
                                          injected crash EVERY time it is
@@ -124,6 +138,7 @@ EVENT_CORRUPT = "corrupt_solution"  # solution vector perturbed post-solve
 EVENT_OVERLOAD = "overload"         # service admission forced to reject
 EVENT_DEVICE_LOSS = "device_loss"   # backend death raised mid-solve
 EVENT_POISON_CASE = "poison_case"   # targeted case crashes its dispatch
+EVENT_STALE_SEED = "stale_seed"     # warm-start seed corrupted pre-solve
 
 
 class InjectedCrashError(RuntimeError):
@@ -165,7 +180,9 @@ class FaultPlan:
                  device_loss: bool = False,
                  device_loss_after: int = 0,
                  device_loss_n: int = 1,
-                 crash_cases: Iterable = ()):
+                 crash_cases: Iterable = (),
+                 stale_seed: Iterable = (),
+                 stale_seed_scale: float = 0.5):
         self.nonconverge = _norm(nonconverge)
         self.rungs = _norm(rungs)
         self.poison_cases = _norm(poison_cases)
@@ -202,6 +219,12 @@ class FaultPlan:
         # poisonous request keeps crashing on retry, which is exactly
         # what the two-strike quarantine needs to observe
         self.crash_cases = _norm(crash_cases)
+        # stale_seed: corrupt a targeted window's warm-start seed before
+        # the seeded solve (ops/warmstart.plan_group applies it) — the
+        # corruption is rung-independent (seeds exist only where warm
+        # starts do) and deterministic per label
+        self.stale_seed = _norm(stale_seed)
+        self.stale_seed_scale = float(stale_seed_scale)
         self._preempt_fired = False
         self.fired: List[Tuple[str, str]] = []   # (rung/event, label/case)
 
@@ -273,6 +296,13 @@ class FaultPlan:
         self.fired.append((EVENT_DEVICE_LOSS, str(self._solve_calls)))
         return True
 
+    def stale_seed_due(self, label) -> bool:
+        """Should window ``label``'s warm-start seed be corrupted?"""
+        if _match(self.stale_seed, label):
+            self.fired.append((EVENT_STALE_SEED, str(label)))
+            return True
+        return False
+
     def should_crash(self, case_id) -> bool:
         if _match(self.crash_cases, case_id):
             self.fired.append((EVENT_POISON_CASE, str(case_id)))
@@ -303,7 +333,9 @@ _ENV_VARS = ("DERVET_TPU_FAULT_NONCONVERGE", "DERVET_TPU_FAULT_POISON_CASE",
              "DERVET_TPU_FAULT_CORRUPT_SCALE", "DERVET_TPU_FAULT_OVERLOAD",
              "DERVET_TPU_FAULT_OVERLOAD_N", "DERVET_TPU_FAULT_DEVICE_LOSS",
              "DERVET_TPU_FAULT_DEVICE_LOSS_AFTER",
-             "DERVET_TPU_FAULT_DEVICE_LOSS_N", "DERVET_TPU_FAULT_POISON")
+             "DERVET_TPU_FAULT_DEVICE_LOSS_N", "DERVET_TPU_FAULT_POISON",
+             "DERVET_TPU_FAULT_STALE_SEED",
+             "DERVET_TPU_FAULT_STALE_SEED_SCALE")
 _ENV_PLAN: Optional[FaultPlan] = None
 _ENV_SNAPSHOT: Optional[tuple] = None
 
@@ -321,8 +353,9 @@ def _plan_from_env() -> Optional[FaultPlan]:
     dl = os.environ.get("DERVET_TPU_FAULT_DEVICE_LOSS", "").strip().lower()
     dl_on = dl not in ("", "0", "false", "off")
     crash = os.environ.get("DERVET_TPU_FAULT_POISON")
+    ss = os.environ.get("DERVET_TPU_FAULT_STALE_SEED")
     if not (nc or pc or cf or hg or sl or pa or cr or ov_on or dl_on
-            or crash):
+            or crash or ss):
         return None
     ov_n = os.environ.get("DERVET_TPU_FAULT_OVERLOAD_N")
     rungs = os.environ.get("DERVET_TPU_FAULT_RUNGS", RUNG_SOLVE)
@@ -344,7 +377,10 @@ def _plan_from_env() -> Optional[FaultPlan]:
             os.environ.get("DERVET_TPU_FAULT_DEVICE_LOSS_AFTER", 0)),
         device_loss_n=int(
             os.environ.get("DERVET_TPU_FAULT_DEVICE_LOSS_N", 1)),
-        crash_cases=crash or ())
+        crash_cases=crash or (),
+        stale_seed=ss or (),
+        stale_seed_scale=float(
+            os.environ.get("DERVET_TPU_FAULT_STALE_SEED_SCALE", 0.5)))
 
 
 def get_plan() -> Optional[FaultPlan]:

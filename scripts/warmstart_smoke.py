@@ -1,0 +1,120 @@
+"""CI smoke: the warm-start subsystem on the cpu XLA backend, no chip.
+
+Boots a :class:`~dervet_tpu.service.server.ScenarioService`, serves one
+COLD request, then the identical request again WARM, and gates the
+warm-start acceptance contract:
+
+* >= 30% median iteration reduction on the warm pass (ledger
+  ``iters p50`` cold vs seeded — exact-match substitution drives it to
+  0);
+* 100% of the warm pass's windows carry an accepted float64
+  certificate (a warm start must never weaken the trust layer);
+* ZERO compile events on the warm pass (the seeded program family is
+  part of the cold round's warm-up, so a warm round compiles nothing);
+* the warm pass's results are BYTE-IDENTICAL to the cold pass's across
+  the full results-CSV surface (substitution re-verifies the stored
+  solution in float64, then ships it verbatim).
+
+Env knobs: SMOKE_CASES (default 2), SMOKE_MONTHS (default 1).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    from dervet_tpu.benchlib import (synthetic_sensitivity_cases,
+                                     validate_solve_ledger)
+    from dervet_tpu.service import ScenarioService
+
+    n_cases = int(os.environ.get("SMOKE_CASES", "2"))
+    months = int(os.environ.get("SMOKE_MONTHS", "1"))
+    cases = {i: c for i, c in enumerate(
+        synthetic_sensitivity_cases(n_cases, months=months))}
+
+    svc = ScenarioService(backend="jax", max_wait_s=0.0)
+    svc.start()
+    try:
+        cold_res = svc.submit(cases, request_id="cold").result(timeout=600)
+        cold_led = svc.last_round_ledger
+        warm_res = svc.submit(cases, request_id="warm").result(timeout=600)
+        warm_led = svc.last_round_ledger
+        metrics = svc.metrics()
+    finally:
+        svc.drain()
+
+    validate_solve_ledger(warm_led)
+    cold_p50 = (cold_led.get("warm_start") or {}).get("iters_p50_cold")
+    if cold_p50 is None:
+        cold_p50 = cold_led["iters"]["p50"]
+    warm = warm_led.get("warm_start") or {}
+    warm_p50 = warm.get("iters_p50_seeded")
+    n_windows = sum(len(inst.scenario.windows)
+                    for inst in warm_res.instances.values())
+
+    # gate 1: >= 30% median iteration reduction on the warm pass
+    if warm.get("seeded", 0) != n_windows:
+        raise AssertionError(
+            f"warm pass seeded {warm.get('seeded')}/{n_windows} windows "
+            f"(warm_start: {warm})")
+    if warm_p50 is None or cold_p50 <= 0 or \
+            warm_p50 > 0.7 * cold_p50:
+        raise AssertionError(
+            f"warm iters p50 {warm_p50} vs cold {cold_p50}: the >=30% "
+            "median iteration-reduction gate failed")
+
+    # gate 2: 100% certified on the warm pass
+    cert = warm_res.run_health["certification"]
+    if not cert["enabled"] or cert["windows_certified"] != n_windows \
+            or cert["windows"]["rejected_final"]:
+        raise AssertionError(f"warm pass not 100% certified: {cert}")
+
+    # gate 3: zero compile events on the warm pass
+    warm_compiles = int(warm_led["totals"]["compile_events"])
+    if warm_compiles:
+        raise AssertionError(
+            f"warm pass compiled {warm_compiles} program(s) — the "
+            "seeded program family must be part of the cold warm-up")
+
+    # gate 4: byte-identical results-CSV surface, warm vs cold
+    with tempfile.TemporaryDirectory() as td:
+        cold_res.save_as_csv(Path(td) / "cold")
+        warm_res.save_as_csv(Path(td) / "warm")
+        names = sorted(p.name for p in (Path(td) / "cold").glob("*.csv"))
+        if not names or names != sorted(
+                p.name for p in (Path(td) / "warm").glob("*.csv")):
+            raise AssertionError("cold/warm CSV surfaces differ in shape")
+        for name in names:
+            a = (Path(td) / "cold" / name).read_bytes()
+            b = (Path(td) / "warm" / name).read_bytes()
+            if a != b:
+                raise AssertionError(
+                    f"{name}: warm pass differs from the cold pass — "
+                    "byte-identity gate failed")
+
+    print(json.dumps({
+        "smoke": "warmstart", "ok": True,
+        "windows": n_windows,
+        "iters_p50_cold": int(cold_p50),
+        "iters_p50_warm": int(warm_p50),
+        "reduction": round(1.0 - warm_p50 / cold_p50, 4),
+        "substituted": warm.get("substituted"),
+        "warm_compile_events": warm_compiles,
+        "memory": metrics["warm_start"],
+        "seeded_windows_total": metrics["rounds"]["seeded_windows"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,529 @@
+"""Warm-start subsystem: solution memory seeding PDHG across requests,
+refinement tiers, and escalation rungs (ops/warmstart.py).
+
+The contract under test:
+
+* a seeded solve converges in FEWER iterations than a cold one, and a
+  zero seed reproduces the cold start bit for bit;
+* a warm service's results are BYTE-IDENTICAL to a cold service's on
+  repeat requests (exact-match substitution re-verifies the stored
+  solution against the full convergence criteria in float64, then ships
+  it verbatim), with 100% certification and zero device dispatches /
+  compile events on the warm pass;
+* ``DERVET_TPU_WARMSTART=0`` kills the subsystem live (cold path, no
+  ``warm`` ledger entries);
+* the memory is a bounded LRU — a tiny ``DERVET_TPU_WARMSTART_CAP``
+  evicts but never crashes a round;
+* the escalation ladder's retry rung seeds from the failed member's
+  last iterate and converges in fewer iterations than the original
+  attempt;
+* the ``stale_seed`` fault corrupts a seed and the solve STILL
+  converges and certifies — seed corruption costs iterations, never
+  correctness — with the extra iterations attributed in the ledger;
+* the design screen's refinement tiers seed each other through the
+  shared memory, and a repeat design request reproduces the certified
+  frontier exactly.
+"""
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from dervet_tpu.benchlib import synthetic_sensitivity_cases
+from dervet_tpu.ops import warmstart
+from dervet_tpu.ops.lp import LPBuilder
+from dervet_tpu.ops.pdhg import (STATUS_CONVERGED, CompiledLPSolver,
+                                 PDHGOptions)
+from dervet_tpu.scenario.scenario import (MicrogridScenario, SolverCache,
+                                          resolve_group, run_dispatch)
+from dervet_tpu.utils import faultinject
+
+
+def _arb_lp(T=48, seed=1):
+    """Small battery-arbitrage LP (same block structure the dispatch
+    engine emits)."""
+    rng = np.random.default_rng(seed)
+    price = rng.uniform(10, 80, T) / 1000
+    b = LPBuilder()
+    ch = b.var("ch", T, 0.0, 250.0)
+    dis = b.var("dis", T, 0.0, 250.0)
+    ene = b.var("ene", T, 0.0, 1000.0)
+    D = np.eye(T) - np.eye(T, k=-1)
+    rhs = np.zeros(T)
+    rhs[0] = 500.0
+    b.add_rows("soe", [(ene, D), (ch, -0.85), (dis, 1.0)], "eq", rhs)
+    b.add_cost(ch, price)
+    b.add_cost(dis, -price)
+    return b.build()
+
+
+def _run_round(cases, cache):
+    """One dispatch round over fresh scenarios; returns (scenarios,
+    summarized solve ledger)."""
+    scens = [MicrogridScenario(c) for c in cases]
+    run_dispatch(scens, backend="jax", solver_cache=cache)
+    return scens, scens[0].solve_metadata.get("solve_ledger")
+
+
+def _assert_solutions_equal(a, b):
+    for s, v in zip(a, b):
+        assert s.objective_values == v.objective_values
+        assert set(s._solution) == set(v._solution)
+        for name in s._solution:
+            assert np.array_equal(s._solution[name], v._solution[name]), \
+                name
+
+
+# ---------------------------------------------------------------------------
+# Solver-level seeding (init_state x0/y0 override)
+# ---------------------------------------------------------------------------
+
+class TestSeededSolver:
+    def test_seeded_solve_converges_faster(self):
+        lp = _arb_lp()
+        solver = CompiledLPSolver(lp, PDHGOptions(pallas_chunk=False))
+        cold = solver.solve()
+        assert bool(cold.converged)
+        warm = solver.solve(x0=np.asarray(cold.x), y0=np.asarray(cold.y))
+        assert bool(warm.converged)
+        assert int(warm.iters) < int(cold.iters)
+
+    def test_zero_seed_is_cold_start_bitwise(self):
+        """clip(0 / dc) == clip(0): the seeded program with zero seeds
+        reproduces the unseeded program's result exactly — the property
+        that lets partially-seeded batches leave cold members' results
+        untouched."""
+        lp = _arb_lp()
+        solver = CompiledLPSolver(lp, PDHGOptions(pallas_chunk=False))
+        C = np.stack([lp.c, lp.c * 1.01, lp.c * 0.99])
+        cold = solver.solve(c=C)
+        zero = solver.solve(c=C, x0=np.zeros((3, lp.n)),
+                            y0=np.zeros((3, lp.m)))
+        assert np.array_equal(np.asarray(cold.x), np.asarray(zero.x))
+        assert np.array_equal(np.asarray(cold.obj), np.asarray(zero.obj))
+        assert np.array_equal(np.asarray(cold.iters),
+                              np.asarray(zero.iters))
+
+    def test_out_of_box_seed_is_clipped_and_converges(self):
+        """A stale seed outside the instance's box is clipped into it —
+        it can cost iterations, never break the solve."""
+        lp = _arb_lp()
+        solver = CompiledLPSolver(lp, PDHGOptions(pallas_chunk=False))
+        bad_x = np.full(lp.n, 1e6)
+        bad_y = np.full(lp.m, -1e3)
+        res = solver.solve(x0=bad_x, y0=bad_y)
+        assert bool(res.converged)
+
+    def test_partial_seed_leaves_cold_members_bitwise(self):
+        """Mixed batch: member 0 seeded from its own solution, members
+        1-2 zero-seeded — the cold members' results match the fully-cold
+        batch bit for bit."""
+        lp = _arb_lp()
+        solver = CompiledLPSolver(lp, PDHGOptions(pallas_chunk=False))
+        C = np.stack([lp.c, lp.c * 1.02, lp.c * 0.98])
+        cold = solver.solve(c=C)
+        X0 = np.zeros((3, lp.n))
+        Y0 = np.zeros((3, lp.m))
+        X0[0] = np.asarray(cold.x)[0]
+        Y0[0] = np.asarray(cold.y)[0]
+        mixed = solver.solve(c=C, x0=X0, y0=Y0)
+        assert np.asarray(mixed.iters)[0] <= np.asarray(cold.iters)[0]
+        for i in (1, 2):
+            assert np.array_equal(np.asarray(mixed.x)[i],
+                                  np.asarray(cold.x)[i])
+
+
+# ---------------------------------------------------------------------------
+# SolutionMemory: lookup grades, LRU bound, host convergence check
+# ---------------------------------------------------------------------------
+
+class TestSolutionMemory:
+    def _solved(self):
+        lp = _arb_lp()
+        solver = CompiledLPSolver(lp, PDHGOptions(pallas_chunk=False))
+        res = solver.solve()
+        return lp, solver, np.asarray(res.x), np.asarray(res.y), \
+            float(res.obj)
+
+    def test_exact_requires_data_and_tolerance_tag(self):
+        lp, solver, x, y, obj = self._solved()
+        mem = warmstart.SolutionMemory(max_entries=8)
+        tag = warmstart.opts_tag(solver.opts)
+        mem.store("sk", lp, tag, x, y, obj)
+        e, kind = mem.lookup("sk", lp, tag)
+        assert kind == "exact" and np.array_equal(e.x, x)
+        # same data, different tolerance regime -> near (seed-only)
+        loose = warmstart.opts_tag(PDHGOptions.screening())
+        e2, kind2 = mem.lookup("sk", lp, loose)
+        assert kind2 == "near"
+        # perturbed data -> near via quantized digest / feature vector
+        import copy
+        lp2 = copy.copy(lp)
+        lp2.c = lp.c * 1.001
+        e3, kind3 = mem.lookup("sk", lp2, tag)
+        assert kind3 == "near"
+        # unknown structure -> miss
+        e4, kind4 = mem.lookup("other", lp, tag)
+        assert e4 is None and kind4 is None
+
+    def test_lru_cap_evicts(self):
+        lp, solver, x, y, obj = self._solved()
+        mem = warmstart.SolutionMemory(max_entries=2)
+        tag = warmstart.opts_tag(solver.opts)
+        import copy
+        for i in range(5):
+            lpi = copy.copy(lp)
+            lpi.c = lp.c * (1.0 + 0.1 * i)
+            mem.store("sk", lpi, tag, x, y, obj)
+        snap = mem.snapshot()
+        assert snap["entries"] == 2
+        assert snap["evictions"] == 3
+        # lookups still work after eviction
+        e, kind = mem.lookup("sk", lp, tag)
+        assert kind in ("near", None) or e is not None
+
+    def test_host_convergence_check(self):
+        lp, solver, x, y, obj = self._solved()
+        assert warmstart.check_converged_host(lp, x, y, solver.opts)
+        assert not warmstart.check_converged_host(lp, x * 3 + 1, y,
+                                                  solver.opts)
+        # wrong shapes / non-finite are rejected, not crashed
+        assert not warmstart.check_converged_host(lp, x[:-1], y,
+                                                  solver.opts)
+        bad = x.copy()
+        bad[0] = np.nan
+        assert not warmstart.check_converged_host(lp, bad, y, solver.opts)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch-level: byte identity, kill switch, LRU under dispatch, faults
+# ---------------------------------------------------------------------------
+
+class TestWarmDispatch:
+    def test_repeat_round_byte_identical_and_substituted(self):
+        """The acceptance contract end to end: a repeat round ships
+        byte-identical results with zero device dispatches, zero compile
+        events, iters 0, 100% certification — and the ledger measures
+        the win against the cold baseline."""
+        cases = synthetic_sensitivity_cases(2, months=1)
+        cache = SolverCache(pad_grid=True, warm_start=True)
+        s1, led1 = _run_round(cases, cache)
+        s2, led2 = _run_round(cases, cache)
+        _assert_solutions_equal(s1, s2)
+        w = led2["warm_start"]
+        assert w["substituted"] == w["seeded"] == 2
+        assert w["iters_p50_seeded"] == 0
+        assert w["iters_saved"] > 0
+        g = [g for g in led2["groups"] if g.get("rung") == "initial"][0]
+        assert g["warm"]["baseline_cold_p50"] > 0
+        assert g["dispatches"] == 0 and g["compile_events"] == 0
+        # the acceptance gate: >=30% median iteration reduction
+        cold_p50 = led1["warm_start"]["iters_p50_cold"]
+        assert w["iters_p50_seeded"] <= 0.7 * cold_p50
+        # every substituted window still carries a full certificate
+        for s in s2:
+            cert = s.certification
+            assert cert["certified"] + cert["certified_loose"] == \
+                len(s.windows)
+            assert cert["rejected"] == 0
+
+    def test_warm_first_round_equals_cold_round(self):
+        """An empty memory's first round is the cold path bit for bit."""
+        cases = synthetic_sensitivity_cases(2, months=1)
+        s_warm, _ = _run_round(cases, SolverCache(pad_grid=True,
+                                                  warm_start=True))
+        s_cold, led = _run_round(cases, SolverCache(pad_grid=True))
+        _assert_solutions_equal(s_warm, s_cold)
+        assert led.get("warm_start") is None      # no memory, no claims
+
+    def test_kill_switch_forces_cold_path(self, monkeypatch):
+        """DERVET_TPU_WARMSTART=0 read live: an existing warm cache
+        stops seeding (no ``warm`` ledger section), and a cache built
+        under the switch never creates a memory at all."""
+        cases = synthetic_sensitivity_cases(1, months=1)
+        cache = SolverCache(pad_grid=True, warm_start=True)
+        _run_round(cases, cache)
+        monkeypatch.setenv(warmstart.WARMSTART_ENV, "0")
+        s2, led2 = _run_round(cases, cache)
+        assert led2.get("warm_start") is None
+        g = [g for g in led2["groups"] if g.get("rung") == "initial"][0]
+        assert "warm" not in g and g["iters_p50"] > 0   # genuinely cold
+        assert SolverCache(warm_start=True).memory is None
+
+    def test_tiny_lru_cap_never_crashes_a_round(self, monkeypatch):
+        monkeypatch.setenv(warmstart.CAP_ENV, "1")
+        cases = synthetic_sensitivity_cases(2, months=1)
+        cache = SolverCache(pad_grid=True, warm_start=True)
+        assert cache.memory.max_entries == 1
+        s1, _ = _run_round(cases, cache)
+        s2, led2 = _run_round(cases, cache)
+        assert cache.memory.snapshot()["evictions"] >= 1
+        # the round completes and certifies; the one surviving entry may
+        # still substitute its member
+        for s in s2:
+            assert s.quarantine is None
+            cert = s.certification
+            assert cert["certified"] + cert["certified_loose"] == \
+                len(s.windows)
+
+    def test_partial_substitution_keeps_compiled_shapes(self):
+        """A warm round where SOME members substitute pads the device
+        subset to the FULL group's bucket — the shape the cold round
+        compiled — so substitution never mints a new program shape
+        (zero compile events even on a mixed repeat)."""
+        fam = synthetic_sensitivity_cases(3, months=1)
+        cache = SolverCache(pad_grid=True, warm_start=True)
+        _run_round(fam[:2], cache)                    # cold: bucket 8
+        s2, led2 = _run_round([fam[0], fam[2]], cache)
+        w = led2["warm_start"]
+        # the new member near-matches the stored neighbors (same
+        # structure), so it is iterate-seeded rather than cold
+        assert w["substituted"] == 1 and w["near"] >= 1
+        for s in s2:
+            assert s.quarantine is None
+        # the shape contract itself (single-device serving): a shrunken
+        # subset pads to the FULL group's bucket, never a smaller one or
+        # the single-instance family.  (This 8-virtual-device platform
+        # rides the sharded path, which does mesh-multiple padding — so
+        # the decision is pinned directly.)
+        from dervet_tpu.scenario.scenario import _subset_pad_to
+        assert _subset_pad_to(cache, 2, 1, multi_dev=False) == 8
+        assert _subset_pad_to(cache, 10, 5, multi_dev=False) == 32
+        assert _subset_pad_to(cache, 10, 5, multi_dev=True) is None
+        assert _subset_pad_to(SolverCache(), 10, 5,
+                              multi_dev=False) is None   # pad_grid off
+
+    def test_cert_rejection_invalidates_memory_entry(self):
+        """A certificate rejection drops the memory entry that vouched
+        for the data: without invalidation, a wrong-but-KKT-passing
+        entry would be re-substituted, re-rejected, and re-escalated on
+        every exact repeat forever.  Driven with the corrupt_solution
+        fault: the substituted answer is corrupted post-solve, the
+        certifier rejects it, the entry is invalidated, the ladder
+        recovers, and the NEXT round goes cold and re-stores."""
+        cases = synthetic_sensitivity_cases(1, months=1)
+        cache = SolverCache(pad_grid=True, warm_start=True)
+        _run_round(cases, cache)                      # populate
+        with faultinject.inject(corrupt={"all"}, rungs={"solve"}):
+            s2, _ = _run_round(cases, cache)
+        assert cache.memory.snapshot()["invalidated"] >= 1
+        for s in s2:
+            assert s.quarantine is None
+            assert s.certification["rejected_then_recovered"] >= 1
+        # the repeat after invalidation runs cold (no stale substitution
+        # loop) and repopulates the memory
+        s3, led3 = _run_round(cases, cache)
+        w = led3["warm_start"]
+        assert w["substituted"] == 0 and w["cold"] >= 1
+        for s in s3:
+            assert s.quarantine is None
+        s4, led4 = _run_round(cases, cache)
+        assert led4["warm_start"]["substituted"] == 1   # healthy again
+
+    def test_stale_seed_costs_iterations_never_correctness(self):
+        """The stale_seed fault corrupts the warm seed: the member is
+        demoted from substitution to iterate seeding, converges anyway,
+        certifies, and the ledger attributes the extra iterations to a
+        seeded member with the fault counted."""
+        cases = synthetic_sensitivity_cases(1, months=1)
+        cache = SolverCache(pad_grid=True, warm_start=True)
+        s1, _ = _run_round(cases, cache)
+        with faultinject.inject(stale_seed={"all"}) as plan:
+            s2, led2 = _run_round(cases, cache)
+        assert any(ev == faultinject.EVENT_STALE_SEED
+                   for ev, _ in plan.fired)
+        w = led2["warm_start"]
+        assert w["stale_seed_faults"] >= 1
+        assert w["substituted"] == 0          # demoted to iterate seeding
+        assert w["seeded"] == 1
+        assert w["iters_p50_seeded"] > 0      # the corruption's cost
+        for s in s2:
+            assert s.quarantine is None
+            cert = s.certification
+            assert cert["certified"] + cert["certified_loose"] == \
+                len(s.windows)
+            assert cert["rejected"] == 0
+        # correctness untouched: same answers as the clean first round
+        # to solver tolerance
+        for a, b in zip(s1, s2):
+            for k, av in a.objective_values.items():
+                assert av["Total Objective"] == pytest.approx(
+                    b.objective_values[k]["Total Objective"], rel=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Service-level: warm vs cold byte identity across the full CSV surface
+# ---------------------------------------------------------------------------
+
+class TestServiceWarmRepeat:
+    def test_repeat_request_csv_surface_identical_to_cold_service(
+            self, tmp_path, monkeypatch):
+        """Two identical requests through a WARM service vs a COLD
+        (kill-switched) service: every results CSV byte-identical in
+        both rounds, the warm repeat fully substituted with zero compile
+        events, and the warm pass 100% certified."""
+        from dervet_tpu.service import ScenarioService
+        cases = {i: c for i, c in
+                 enumerate(synthetic_sensitivity_cases(2, months=1))}
+
+        def two_rounds(svc):
+            f1 = svc.submit(cases, request_id="r1")
+            assert svc.run_once() == 1
+            f2 = svc.submit(cases, request_id="r2")
+            assert svc.run_once() == 1
+            return f1.result(0), f2.result(0)
+
+        monkeypatch.setenv(warmstart.WARMSTART_ENV, "0")
+        cold_svc = ScenarioService(backend="jax", max_wait_s=0.0)
+        try:
+            c1, c2 = two_rounds(cold_svc)
+            assert cold_svc.metrics()["warm_start"] is None
+        finally:
+            cold_svc.close()
+        monkeypatch.delenv(warmstart.WARMSTART_ENV)
+        warm_svc = ScenarioService(backend="jax", max_wait_s=0.0)
+        try:
+            w1, w2 = two_rounds(warm_svc)
+            led = warm_svc.last_round_ledger
+            m = warm_svc.metrics()
+        finally:
+            warm_svc.close()
+        assert led["warm_start"]["substituted"] == 2
+        assert led["totals"]["compile_events"] == 0
+        assert m["rounds"]["substituted_windows"] == 2
+        assert m["warm_start"]["substituted"] == 2
+        for res, sub in ((c1, "c1"), (c2, "c2"), (w1, "w1"), (w2, "w2")):
+            res.save_as_csv(tmp_path / sub)
+        for cold_dir, warm_dir in (("c1", "w1"), ("c2", "w2")):
+            names = sorted(p.name for p in
+                           (tmp_path / cold_dir).glob("*.csv"))
+            assert names == sorted(p.name for p in
+                                   (tmp_path / warm_dir).glob("*.csv"))
+            assert names
+            for name in names:
+                a = (tmp_path / cold_dir / name).read_bytes()
+                b = (tmp_path / warm_dir / name).read_bytes()
+                assert a == b, f"{warm_dir}/{name} differs from cold"
+        # the warm repeat is certified end to end
+        cert = w2.run_health["certification"]
+        assert cert["enabled"] and cert["windows"]["rejected_final"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Escalation-ladder retry rung: seeded from the failed member's iterate
+# ---------------------------------------------------------------------------
+
+class _Ctx:
+    def __init__(self, label=5):
+        self.label = label
+
+
+class _Scn:
+    def __init__(self):
+        self.health = {"clean": 0, "inaccurate": 0, "retried": 0,
+                       "cpu_fallback": 0, "quarantined": 0,
+                       "retry_seconds": 0.0}
+
+    class case:
+        case_id = 0
+
+
+class TestRetryRungSeeded:
+    def test_retry_converges_in_fewer_iterations_than_original(self):
+        """Regression for the cold-retry bug: the boosted-budget retry
+        now seeds from the failed member's last iterate instead of
+        restarting from zero — with an injected forced non-convergence
+        (the iterate actually converged), the retry accepts within its
+        first convergence check instead of re-paying the full count."""
+        lp = _arb_lp()
+        opts = PDHGOptions(pallas_chunk=False)
+        s = _Scn()
+        ledger = []
+        with faultinject.inject(nonconverge={"5"}, rungs={"solve"}):
+            xs, objs, ok, diags = resolve_group(
+                [(s, _Ctx(5), lp)], "jax", opts, ledger=ledger)
+        assert ok == [True]
+        assert s.health["retried"] == 1
+        initial = [e for e in ledger if e.get("rung") == "initial"][0]
+        retry = [e for e in ledger if e.get("rung") == "retry"][0]
+        assert retry["warm"]["source"] == "failed_iterate"
+        assert retry["warm"]["seeded"] == 1
+        assert retry["iters_p50"] < initial["iters_p50"]
+
+    def test_retry_cold_with_kill_switch(self, monkeypatch):
+        monkeypatch.setenv(warmstart.WARMSTART_ENV, "0")
+        lp = _arb_lp()
+        opts = PDHGOptions(pallas_chunk=False)
+        s = _Scn()
+        ledger = []
+        with faultinject.inject(nonconverge={"5"}, rungs={"solve"}):
+            xs, objs, ok, diags = resolve_group(
+                [(s, _Ctx(5), lp)], "jax", opts, ledger=ledger)
+        assert ok == [True]
+        retry = [e for e in ledger if e.get("rung") == "retry"][0]
+        assert "warm" not in retry
+        initial = [e for e in ledger if e.get("rung") == "initial"][0]
+        assert retry["iters_p50"] >= initial["iters_p50"]  # genuinely cold
+
+
+# ---------------------------------------------------------------------------
+# Design screen: tiers seed each other; repeat design reproduces frontier
+# ---------------------------------------------------------------------------
+
+def _design_case():
+    from dervet_tpu.benchlib import synthetic_case
+    c = synthetic_case()
+    c.scenario["allow_partial_year"] = True
+    c.datasets.time_series = c.datasets.time_series.iloc[: 24 * 3]
+    return c
+
+
+class TestDesignTierSeeding:
+    def test_refinement_tiers_seed_from_prior_tier(self):
+        """Tier i+1 re-screens the same candidates: its members
+        near-match tier i's stored iterates through the shared memory
+        (the tolerance tag differs, so they can only SEED — a loose
+        tier's answer never substitutes at a tighter tier)."""
+        from dervet_tpu.design.population import (DERBounds, DesignSpec,
+                                                  generate_population)
+        from dervet_tpu.design.screen import (ScreeningCaches,
+                                              screen_candidates)
+        spec = DesignSpec(bounds={("Battery", "1"):
+                                  DERBounds(kw=(200.0, 1000.0),
+                                            kwh=(400.0, 4000.0))},
+                          population=8, top_k=2, refine_rounds=1)
+        caches = ScreeningCaches(pad_grid=True)
+        assert caches.memory is not None
+        cands = generate_population(spec)
+        report = screen_candidates(_design_case(), cands, caches=caches,
+                                   refine_rounds=1, top_k=2)
+        assert report.converged
+        snap = caches.memory.snapshot()
+        assert snap["stores"] > 0
+        assert snap["hits_near"] > 0       # the refinement round seeded
+        # tier caches share ONE memory object
+        assert caches.tier(0).memory is caches.tier(1).memory
+
+    def test_repeat_design_request_reproduces_certified_frontier(self):
+        """A repeat design request against warm caches reproduces the
+        certified frontier: same finalists, byte-identical certified
+        totals (the finalists' exact-match entries substitute)."""
+        from dervet_tpu.design.frontier import run_design
+        from dervet_tpu.design.population import DERBounds, DesignSpec
+        from dervet_tpu.design.screen import ScreeningCaches
+        from dervet_tpu.scenario.scenario import SolverCache
+        spec = DesignSpec(bounds={("Battery", "1"):
+                                  DERBounds(kw=(200.0, 1000.0),
+                                            kwh=(400.0, 4000.0))},
+                          population=6, top_k=2, refine_rounds=0)
+        caches = ScreeningCaches(pad_grid=True)
+        final_cache = SolverCache(pad_grid=True, memory=caches.memory)
+        f1 = run_design(_design_case(), spec, caches=caches,
+                        final_cache=final_cache)
+        f2 = run_design(_design_case(), spec, caches=caches,
+                        final_cache=final_cache)
+        assert list(f1.frontier["candidate"]) == \
+            list(f2.frontier["candidate"])
+        assert list(f1.frontier["total"]) == list(f2.frontier["total"])
+        assert f1.all_finalists_certified and f2.all_finalists_certified
