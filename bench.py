@@ -31,6 +31,26 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def check_kernel_gate(ledger, leg: str) -> None:
+    """Fail the leg when a chunk-kernel FALLBACK REGRESSION appears in
+    its solve ledger: the fused Pallas kernel was eligible and requested
+    but a runtime compile failure knocked the dispatch onto the XLA scan
+    path (the BENCH_r03 silent-fallback shape — ROADMAP item 4 says it
+    must fail a gate, not scroll past as a log line).  Expected scan
+    reasons (cpu backend, unsupported shape, single-instance path) are
+    not regressions."""
+    kern = (ledger or {}).get("kernel")
+    if not kern:
+        return
+    bad = {r: n for r, n in (kern.get("fallback_reasons") or {}).items()
+           if r.startswith("runtime_disabled")}
+    if bad:
+        log(f"bench[{leg}]: KERNEL FALLBACK REGRESSION — "
+            f"{sum(bad.values())} group(s) fell back to the XLA scan "
+            f"path after a runtime compile failure: {bad}")
+        raise SystemExit(9)
+
+
 def main() -> None:
     import jax
 
@@ -223,6 +243,11 @@ def main() -> None:
             legs["serving"] = serving_leg()
         except Exception as e:          # noqa: BLE001
             legs["serving"] = {"error": str(e)[:300]}
+    if int(os.environ.get("BENCH_ELASTIC", "1")):
+        try:
+            legs["serving_elastic"] = serving_elastic_leg()
+        except Exception as e:          # noqa: BLE001
+            legs["serving_elastic"] = {"error": str(e)[:300]}
     if int(os.environ.get("BENCH_WARMSTART", "1")):
         try:
             legs["warm_start"] = warm_start_leg()
@@ -391,6 +416,7 @@ def sensitivity_leg() -> dict:
         ledger = getattr(res_w, "solve_ledger", None)
         if ledger is not None:
             validate_solve_ledger(ledger)
+            check_kernel_gate(ledger, "sensitivity")
         t0 = time.time()
         res_c = DERVET(mp, base_path="/root/reference").solve(backend="cpu")
         t_cpu = time.time() - t0
@@ -588,6 +614,7 @@ def serving_leg() -> dict:
         results = [f.result() for f in futs]
         t_load = time.time() - t0
         m = svc.metrics()
+        check_kernel_gate(svc.last_round_ledger, "serving")
     finally:
         svc.close()
 
@@ -629,6 +656,192 @@ def serving_leg() -> dict:
         "queue": {k: m["queue"][k] for k in
                   ("admitted", "rejected_full", "rejected_overload",
                    "expired")},
+    }
+
+
+def serving_elastic_leg() -> dict:
+    """Elastic mesh-serving proof (parallel/elastic.py): the SAME mixed
+    workload served three ways — single-device scheduler
+    (``DERVET_TPU_ELASTIC_DEVICES=1``), the serial global scheduler
+    (``DERVET_TPU_ELASTIC=0``: one shard_map stream, devices take turns),
+    and the elastic mesh-wide scheduler (per-device in-flight rounds +
+    work stealing).
+
+    The workload is N requests whose window lengths differ (the ``n``
+    optimization-hours knob), so one round fans out to more structure
+    groups than devices and placement/stealing has something to do.
+    Each pass runs against a FRESH service with the warm-start memory
+    disabled (substitution would zero the device work and measure
+    nothing); the timed pass is the warm second round, after one
+    untimed round pays the XLA compiles.
+
+    Gates: elastic results BYTE-IDENTICAL to the single-device
+    schedule's (always — placement, mesh size, and stealing may change
+    where windows solve, never what they solve to; the legacy sharded
+    scheduler's bits vary with per-device batch width, so against it
+    the gate is certification-level tolerance); on a real >= 8-
+    accelerator mesh (not virtual CPU host devices, which share
+    physical cores and cannot exhibit real scaling): aggregate
+    throughput >= 4x the single-device scheduler and mean per-device
+    occupancy >= 0.70; kernel-fallback regression fails the gate
+    everywhere."""
+    import numpy as _np
+
+    import jax
+
+    from dervet_tpu.benchlib import synthetic_sensitivity_cases
+    from dervet_tpu.service import ScenarioService
+
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    months = int(os.environ.get("BENCH_ELASTIC_MONTHS", "1"))
+    cases_per = int(os.environ.get("BENCH_ELASTIC_CASES", "2"))
+    n_lengths = int(os.environ.get("BENCH_ELASTIC_LENGTHS",
+                                   str(max(8, min(16, 2 * n_dev)))))
+    # distinct window lengths -> distinct structure groups (+ tail
+    # remainders); horizon is months x ~744 h
+    lengths = [72 + 24 * i for i in range(n_lengths)]
+
+    def workload():
+        return {f"el{i}": {j: c for j, c in enumerate(
+                    synthetic_sensitivity_cases(cases_per, n=n,
+                                                months=months))}
+                for i, n in enumerate(lengths)}
+
+    def run_pass(tag, elastic_env, devices_env=None):
+        prev = {k: os.environ.get(k) for k in
+                ("DERVET_TPU_ELASTIC", "DERVET_TPU_ELASTIC_DEVICES",
+                 "DERVET_TPU_WARMSTART")}
+        os.environ["DERVET_TPU_ELASTIC"] = elastic_env
+        if devices_env is None:
+            os.environ.pop("DERVET_TPU_ELASTIC_DEVICES", None)
+        else:
+            os.environ["DERVET_TPU_ELASTIC_DEVICES"] = devices_env
+        os.environ["DERVET_TPU_WARMSTART"] = "0"
+        try:
+            # no batcher thread: each wave is submitted and then driven
+            # through ONE deterministic run_once round, so the round
+            # ledger the gates read covers the whole timed pass (a
+            # background batcher could split a wave across rounds and
+            # leave last_round_ledger describing only the tail)
+            svc = ScenarioService(backend="jax", max_wait_s=0.0,
+                                  max_batch_requests=64)
+            try:
+                # round 1 (untimed): pays the XLA compiles
+                futs = {r: svc.submit(c, request_id=f"warm.{r}")
+                        for r, c in workload().items()}
+                svc.run_once()
+                for f in futs.values():
+                    f.result()
+                # round 2 (timed): the steady-state serving rate
+                futs = {r: svc.submit(c, request_id=r)
+                        for r, c in workload().items()}
+                t0 = time.time()
+                svc.run_once()
+                results = {r: f.result() for r, f in futs.items()}
+                wall = time.time() - t0
+                led = svc.last_round_ledger
+                check_kernel_gate(led, "serving_elastic")
+                n_windows = sum((r.solve_ledger or {}).get(
+                    "totals", {}).get("windows", 0)
+                    for r in results.values())
+                log(f"bench[serving_elastic]: {tag} — {len(results)} "
+                    f"requests / {n_windows} windows in {wall:.2f}s "
+                    f"({n_windows / wall:.1f} windows/s)")
+                return {"wall_s": wall, "windows": n_windows,
+                        "results": results, "ledger": led}
+            finally:
+                svc.close()
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    single = run_pass("single-device", "1", devices_env="1")
+    serial = run_pass("serial global scheduler", "0")
+    elastic = run_pass("elastic mesh scheduler", "1")
+
+    # byte identity vs the single-device schedule: the elastic
+    # scheduler must change WHERE windows solve, never what they solve
+    # to.  The serial sharded scheduler is compared at certification
+    # tolerance (its per-device batch width changes the dense-op XLA
+    # reduction order, so its bits depend on the mesh size — elastic's
+    # do not).
+    identical = True
+    serial_close = True
+    for rid, re_ in elastic["results"].items():
+        ru, rs = single["results"][rid], serial["results"][rid]
+        for key in re_.instances:
+            ie, iu, is_ = (re_.instances[key], ru.instances[key],
+                           rs.instances[key])
+            if ie.scenario.objective_values != iu.scenario.objective_values:
+                identical = False
+                log(f"bench[serving_elastic]: objective mismatch vs "
+                    f"single-device {rid}/{key}")
+            for name in ie.scenario._solution:
+                if not _np.array_equal(ie.scenario._solution[name],
+                                       iu.scenario._solution[name]):
+                    identical = False
+                    log(f"bench[serving_elastic]: solution mismatch vs "
+                        f"single-device {rid}/{key}/{name}")
+            for w, oe in ie.scenario.objective_values.items():
+                os_ = is_.scenario.objective_values[w]["Total Objective"]
+                if abs(oe["Total Objective"] - os_) > \
+                        1e-5 * max(1.0, abs(os_)):
+                    serial_close = False
+                    log(f"bench[serving_elastic]: serial-scheduler "
+                        f"objective drift {rid}/{key}/{w}")
+
+    el = (elastic["ledger"] or {}).get("elastic") or {}
+    occ = [d["occupancy"] for d in (el.get("devices") or {}).values()
+           if d["groups"]]
+    mean_occ = float(_np.mean(occ)) if occ else 0.0
+    speedup_single = single["wall_s"] / elastic["wall_s"]
+    speedup_serial = serial["wall_s"] / elastic["wall_s"]
+    real_mesh = platform != "cpu" and n_dev >= 8
+    gates = {"byte_identity_vs_single_device": identical,
+             "serial_scheduler_within_tolerance": serial_close}
+    if real_mesh:
+        gates["throughput_4x_vs_single_device"] = speedup_single >= 4.0
+        gates["mean_occupancy_ge_70"] = mean_occ >= 0.70
+    ok = all(gates.values())
+    log(f"bench[serving_elastic]: {n_dev}x {platform} — elastic "
+        f"{elastic['wall_s']:.2f}s vs serial {serial['wall_s']:.2f}s "
+        f"({speedup_serial:.2f}x) vs single-device "
+        f"{single['wall_s']:.2f}s ({speedup_single:.2f}x); "
+        f"devices with groups {el.get('devices_with_groups')}/{n_dev}, "
+        f"steals {el.get('n_steals')}, mean occupancy {mean_occ:.2f} "
+        f"(min {min(occ) if occ else 0:.2f}); byte-identity "
+        f"{'OK' if identical else 'FAIL'}; gates "
+        f"{'OK' if ok else 'FAIL'}"
+        + ("" if real_mesh else
+           " (4x/occupancy gates skipped: virtual/CPU mesh shares "
+           "physical cores)"))
+    if not ok:
+        raise SystemExit(8)
+    return {
+        "n_devices": n_dev,
+        "platform": platform,
+        "requests": len(lengths),
+        "windows": elastic["windows"],
+        "single_device_wall_s": round(single["wall_s"], 3),
+        "serial_wall_s": round(serial["wall_s"], 3),
+        "elastic_wall_s": round(elastic["wall_s"], 3),
+        "speedup_vs_single_device": round(speedup_single, 2),
+        "speedup_vs_serial": round(speedup_serial, 2),
+        "throughput_windows_per_s": round(
+            elastic["windows"] / elastic["wall_s"], 2),
+        "devices_with_groups": el.get("devices_with_groups"),
+        "steals": el.get("n_steals"),
+        "occupancy_mean": round(mean_occ, 3),
+        "occupancy_min": round(min(occ), 3) if occ else None,
+        "per_device": el.get("devices"),
+        "byte_identical_to_single_device": identical,
+        "serial_scheduler_within_tolerance": serial_close,
+        "gates": gates,
+        "gated_on_real_mesh": real_mesh,
     }
 
 

@@ -311,12 +311,17 @@ def screen_candidates(case, candidates: List[Candidate], *,
         all_failed = None
         with certify.policy_override(policy):
             try:
+                # elastic=False: the screen is ONE wide structure group
+                # (every candidate shares the byte-level structure), so
+                # sharding that single batch over the whole mesh is the
+                # right shape — the elastic scheduler would place it on
+                # one device and idle the rest
                 run_dispatch(round_scens, backend=backend,
                              solver_opts=opts,
                              solver_cache=caches.tier(
                                  rnd if screen_opts_override is None
                                  else "override"),
-                             supervisor=supervisor)
+                             supervisor=supervisor, elastic=False)
             except AggregatedSolverError as e:
                 all_failed = e      # every candidate failed this round
         # on a whole-round failure the scenarios' solve_metadata still

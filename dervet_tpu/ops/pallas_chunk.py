@@ -266,8 +266,11 @@ def _build_banded_call(m: int, n: int, nb: int, offsets: tuple, iters: int,
 
 # set by CompiledLPSolver's (and solve_batch_sharded's) runtime fallback
 # when the kernel still fails to compile on this backend — later solvers
-# then skip the kernel entirely
+# then skip the kernel entirely.  The REASON rides the solve ledger's
+# per-group kernel record (ROADMAP item 4: a silent fallback must show
+# up as a measured regression, not a log line — BENCH_r03).
 RUNTIME_DISABLED = False
+RUNTIME_DISABLED_REASON: Optional[str] = None
 
 
 def supports(op, dtype, precision=None, backend: Optional[str] = None,

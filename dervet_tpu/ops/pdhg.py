@@ -1097,14 +1097,54 @@ def pallas_compiler_options(opts: "PDHGOptions", op=None):
 
 
 def disable_pallas_runtime(e: Exception) -> None:
-    """Mark the Pallas chunk kernel unusable process-wide and say so."""
+    """Mark the Pallas chunk kernel unusable process-wide and say so.
+    The reason is kept for the solve ledger's per-group kernel record,
+    so the fallback is a measured observable (and a bench gate), not
+    just a log line."""
     from . import pallas_chunk
+    first_line = next(iter(str(e).splitlines()), type(e).__name__)
     pallas_chunk.RUNTIME_DISABLED = True
+    pallas_chunk.RUNTIME_DISABLED_REASON = first_line[:200]
     from ..utils.errors import TellUser
     TellUser.warning(
         "fused Pallas chunk kernel unavailable on this backend "
-        f"({str(e).splitlines()[0][:120]}); falling back to the "
-        "XLA scan path")
+        f"({first_line[:120]}); falling back to the XLA scan path")
+
+
+KERNEL_PALLAS = "pallas_chunk"
+KERNEL_SCAN = "xla_scan"
+# fallback reasons the bench gate treats as a REGRESSION: the kernel was
+# eligible and wanted, and a runtime compile failure knocked it out
+KERNEL_REGRESSION_PREFIX = "runtime_disabled"
+
+
+def kernel_selection(solver: "CompiledLPSolver", batched: bool
+                     ) -> tuple[str, Optional[str]]:
+    """Which chunk kernel this solver's next ``_drive`` would run, and —
+    when it is the scan path — why (the fallback reason).  Recorded per
+    group in the solve ledger (ROADMAP item 4): BENCH_r03 showed the
+    fused kernel silently falling back, and a selection that is not a
+    published observable cannot be gated."""
+    from . import pallas_chunk
+    if not batched:
+        return KERNEL_SCAN, "single-instance path (kernel is batch-only)"
+    # runtime kill switch FIRST: the fallback handler also flips
+    # solver.opts.pallas_chunk, and the regression must not be
+    # mis-attributed to a caller's option choice
+    if pallas_chunk.RUNTIME_DISABLED:
+        return KERNEL_SCAN, (
+            f"{KERNEL_REGRESSION_PREFIX}: "
+            f"{pallas_chunk.RUNTIME_DISABLED_REASON or 'compile failure'}")
+    if not solver.opts.pallas_chunk:
+        return KERNEL_SCAN, "pallas_chunk disabled in solver options"
+    if not pallas_chunk.supports(solver.op, solver.opts.dtype,
+                                 solver.opts.precision):
+        backend = jax.default_backend()
+        if backend != "tpu":
+            return KERNEL_SCAN, f"backend {backend!r} (kernel is TPU-only)"
+        return KERNEL_SCAN, \
+            "unsupported shape/dtype/precision for the fused kernel"
+    return KERNEL_PALLAS, None
 
 
 class CompiledLPSolver:
@@ -1115,7 +1155,8 @@ class CompiledLPSolver:
     to ELLPACK gather-matvecs (see module docstring).
     """
 
-    def __init__(self, lp: LP, opts: Optional[PDHGOptions] = None):
+    def __init__(self, lp: LP, opts: Optional[PDHGOptions] = None,
+                 device=None):
         import time as _time
         _t = _time.perf_counter
         _phases: dict[str, float] = {}
@@ -1123,6 +1164,12 @@ class CompiledLPSolver:
         _disable_cache_if_cpu()
         self.opts = opts or PDHGOptions()
         self.lp = lp
+        # device pinning (elastic dispatch): constants committed to this
+        # device, per-call data follows in _data/_seed_data — so jit
+        # executions land on it and per-device solvers can run
+        # CONCURRENTLY (single-device programs, no collectives to
+        # interleave).  None keeps the default-device behavior.
+        self.device = device
         dtype = self.opts.dtype
         d_r, d_c = ruiz_scaling(lp.K, self.opts.ruiz_iters)
         _phases["ruiz_s"] = _t() - t0
@@ -1155,7 +1202,7 @@ class CompiledLPSolver:
         t0 = _t()
         self.op, self.dr, self.dc, self.eta = jax.block_until_ready(
             jax.device_put((op_host, _hcast(d_r, dtype),
-                            _hcast(d_c, dtype), eta_host)))
+                            _hcast(d_c, dtype), eta_host), device))
         self._make_jits()
         _phases["transfer_s"] = _t() - t0
         self.precondition_breakdown = {
@@ -1226,8 +1273,32 @@ class CompiledLPSolver:
         clone = object.__new__(CompiledLPSolver)
         clone.opts = opts
         clone.lp = self.lp
+        clone.device = self.device
         clone.op, clone.dr, clone.dc, clone.eta = (self.op, self.dr,
                                                    self.dc, self.eta)
+        clone.precondition_breakdown = dict(self.precondition_breakdown)
+        clone._make_jits()
+        clone._solve_lock = threading.Lock()
+        clone.last_stats = None
+        clone._exec_shapes = set()
+        return clone
+
+    def to_device(self, device) -> "CompiledLPSolver":
+        """Clone pinned to ``device``, sharing this solver's
+        preconditioning RESULTS (the Ruiz scalings, step size, and
+        operator tables are copied device-to-device — no re-equilibration,
+        no power iteration) under fresh per-device jits.  This is how a
+        work-stolen structure group, or a solver-cache shard that has
+        never seen the structure, gets a device-resident solver without
+        paying the host preconditioning again; the first execution on the
+        new device is still an honestly-counted compile event."""
+        import threading
+        clone = object.__new__(CompiledLPSolver)
+        clone.opts = self.opts
+        clone.lp = self.lp
+        clone.device = device
+        clone.op, clone.dr, clone.dc, clone.eta = jax.device_put(
+            (self.op, self.dr, self.dc, self.eta), device)
         clone.precondition_breakdown = dict(self.precondition_breakdown)
         clone._make_jits()
         clone._solve_lock = threading.Lock()
@@ -1253,7 +1324,7 @@ class CompiledLPSolver:
         if host_idx:
             host = tuple(_hcast(arrs[i], self.opts.dtype) for i in host_idx)
             t0 = time.perf_counter()
-            put = jax.device_put(host)
+            put = jax.device_put(host, self.device)
             if stats is not None:
                 stats.h2d_s += time.perf_counter() - t0
                 stats.h2d_transfers += len(host)
@@ -1332,7 +1403,7 @@ class CompiledLPSolver:
         if host_idx:
             host = tuple(_hcast(arrs[i], self.opts.dtype) for i in host_idx)
             t0 = time.perf_counter()
-            put = jax.device_put(host)
+            put = jax.device_put(host, self.device)
             if stats is not None:
                 stats.h2d_s += time.perf_counter() - t0
                 stats.h2d_transfers += len(host)

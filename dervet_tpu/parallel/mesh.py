@@ -40,21 +40,82 @@ class ShardedStats(NamedTuple):
     max_prim_res: jax.Array  # worst primal residual across the mesh
 
 
-def warmup_devices() -> dict:
+def _warmup_lp():
+    """A tiny battery-shaped LP (SOE recursion + box + prices — the same
+    block structure every dispatch window emits) for the per-device
+    warm-up solve.  T=8 keeps it milliseconds on any backend."""
+    from ..ops.lp import LPBuilder
+    T = 8
+    b = LPBuilder()
+    ch = b.var("ch", T, 0.0, 10.0)
+    dis = b.var("dis", T, 0.0, 10.0)
+    ene = b.var("ene", T, 0.0, 40.0)
+    D = np.eye(T) - np.eye(T, k=-1)
+    rhs = np.zeros(T)
+    rhs[0] = 20.0
+    b.add_rows("soe", [(ene, D), (ch, -0.85), (dis, 1.0)], "eq", rhs)
+    price = np.linspace(0.01, 0.08, T)
+    b.add_cost(ch, price)
+    b.add_cost(dis, -price)
+    return b.build()
+
+
+def warmup_devices(per_device_solve: bool = True, devices=None) -> dict:
     """Pay backend/device initialization up front (serving layer): the
     first JAX touch of a process initializes the platform, allocates the
     transfer arenas, and compiles a trivial program — tens of
     milliseconds to seconds that would otherwise land inside the FIRST
     request's latency.  A :class:`~dervet_tpu.service.server.
     ScenarioService` calls this at ``start()`` so admission begins on a
-    warm device.  Returns the device inventory for the service's
-    metrics surface."""
-    devs = jax.devices()
+    warm device.
+
+    ``per_device_solve`` additionally runs one TINY bucket-shaped (batch
+    8, the smallest compaction bucket) PDHG solve on EVERY device, not
+    just the default one: the elastic scheduler places groups across the
+    whole mesh, and a device that has never executed anything pays its
+    first-touch cost (allocator arenas, transfer paths, executable
+    build) inside the first request otherwise.  Per-device warm-up
+    timings ride the returned dict (``warmup_s`` keyed by device index)
+    so a sick/slow device is visible at service start.
+
+    ``devices`` restricts the per-device warm solves to that subset
+    (the service passes its elastic device set — warming a device the
+    scheduler will never place a group on is wasted compile time).
+
+    Returns the device inventory for the service's metrics surface."""
+    all_devs = jax.devices()
+    devs = list(devices) if devices is not None else all_devs
     x = jax.device_put(jnp.zeros(8, jnp.float32))
     jax.jit(lambda a: a + 1.0)(x).block_until_ready()
-    return {"n_devices": len(devs),
-            "platform": devs[0].platform,
-            "device_kind": devs[0].device_kind}
+    info = {"n_devices": len(all_devs),
+            "platform": all_devs[0].platform,
+            "device_kind": all_devs[0].device_kind}
+    if per_device_solve:
+        import concurrent.futures as cf
+        import time
+        from ..ops.pdhg import CompiledLPSolver
+        lp = _warmup_lp()
+        t_all = time.perf_counter()
+        base = CompiledLPSolver(lp, device=devs[0])
+
+        def _warm_one(i, d):
+            t0 = time.perf_counter()
+            solver = base if i == 0 else base.to_device(d)
+            C = np.broadcast_to(lp.c, (8, lp.n))    # bucket-shaped batch
+            res = solver.solve(c=np.ascontiguousarray(C))
+            jax.block_until_ready(res.x)
+            return str(i), round(time.perf_counter() - t0, 4)
+
+        # warm the devices CONCURRENTLY: the cost is per-device XLA
+        # compiles of the tiny program, which overlap across threads
+        # exactly like the dispatch pipeline's compile overlap — serial
+        # warm-up would pay n_devices x the compile wall for nothing
+        with cf.ThreadPoolExecutor(max_workers=min(8, len(devs))) as pool:
+            timings = dict(pool.map(lambda a: _warm_one(*a),
+                                    enumerate(devs)))
+        info["warmup_s"] = timings
+        info["warmup_total_s"] = round(time.perf_counter() - t_all, 4)
+    return info
 
 
 def scenario_mesh(n_devices: Optional[int] = None) -> Mesh:

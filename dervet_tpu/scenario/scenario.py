@@ -983,6 +983,19 @@ class SolverCache:
         self.solvers: Dict[tuple, object] = {}
         self.builds = 0
         self.hits = 0
+        # elastic dispatch (parallel/elastic.py): per-device cache shards
+        # — each shard holds solvers whose constants are COMMITTED to its
+        # device, so N worker threads solve concurrently without sharing
+        # device state.  The warm-start memory, the iteration-baseline
+        # hints, and the key->device stickiness live on the ROOT cache,
+        # shared by every shard; a persistent service keeps its shards
+        # (and their compiled per-device programs) across rounds.
+        self.device = None
+        self.device_index: Optional[int] = None
+        self._parent: Optional["SolverCache"] = None
+        self._shards: Dict[int, "SolverCache"] = {}
+        self._iters_ewma: Dict[tuple, float] = {}
+        self._key_device: Dict[tuple, int] = {}
         # serving mode: pad each group's batch up to the pdhg compaction
         # bucket grid ({8, 32, 128, ...}) so a hot service's varying
         # coalesced batch widths collapse onto a handful of XLA program
@@ -1031,12 +1044,122 @@ class SolverCache:
                 if base is not None:
                     solver = base.with_options(opts)
                 else:
-                    solver = CompiledLPSolver(lp0, opts)
+                    donor = self._donor(key) if self.device is not None \
+                        else None
+                    if donor is not None:
+                        # a sibling shard (or the root) already
+                        # preconditioned this structure: copy its
+                        # operator device-to-device instead of
+                        # re-running Ruiz + the power iteration
+                        solver = donor.to_device(self.device)
+                    else:
+                        solver = CompiledLPSolver(lp0, opts,
+                                                  device=self.device)
                 self.solvers[key] = solver
                 self.builds += 1
+                self._mirror(key, built=True)
             else:
                 self.hits += 1
+                self._mirror(key, built=False)
         return solver
+
+    # -- elastic per-device shards (parallel/elastic.py) ---------------
+    def shard_for(self, device, index: int) -> "SolverCache":
+        """The per-device cache shard for ``device`` (created on first
+        use, persistent on this root cache so a service's shards — and
+        their compiled per-device programs — survive across rounds).
+        Shards share the root's pad_grid policy and warm-start memory;
+        their builds/hits mirror into the root counters so dispatch
+        metadata stays a single surface."""
+        root = self._parent or self
+        with root._lock:
+            shard = root._shards.get(index)
+            if shard is None:
+                shard = SolverCache(pad_grid=root.pad_grid,
+                                    memory=root.memory)
+                shard.device = device
+                shard.device_index = index
+                shard._parent = root
+                root._shards[index] = shard
+        return shard
+
+    def _donor(self, key):
+        """A solver for ``key`` on some OTHER device (root or sibling
+        shard) whose preconditioning a new shard can copy.  Called under
+        the shard's lock; takes only the root's lock (shard -> root is
+        the one ordering used anywhere, so no deadlock)."""
+        root = self._parent
+        if root is None:
+            return None
+        with root._lock:
+            donor = root.solvers.get(key)
+            if donor is not None:
+                return donor
+            for shard in root._shards.values():
+                if shard is not self:
+                    donor = shard.solvers.get(key)
+                    if donor is not None:
+                        return donor
+        return None
+
+    def _mirror(self, key, built: bool) -> None:
+        """Mirror a shard's build/hit into the root counters and record
+        key->device stickiness (placement affinity: a structure solves
+        where its compiled program already lives)."""
+        root = self._parent
+        if root is None:
+            if self.device_index is not None:
+                self._key_device.setdefault(key, self.device_index)
+            return
+        with root._lock:
+            if built:
+                root.builds += 1
+            else:
+                root.hits += 1
+            if self.device_index is not None:
+                root._key_device.setdefault(key, self.device_index)
+
+    def device_index_for(self, key) -> Optional[int]:
+        """Sticky device for a structure key (None = unplaced)."""
+        root = self._parent or self
+        with root._lock:
+            return root._key_device.get(key)
+
+    def structures_cached(self) -> int:
+        """Distinct structure keys with a compiled solver anywhere —
+        the root plus every per-device shard (the elastic path builds
+        exclusively in shards)."""
+        root = self._parent or self
+        with root._lock:
+            keys = set(root.solvers)
+            for shard in root._shards.values():
+                keys.update(shard.solvers)
+        return len(keys)
+
+    def clear(self) -> None:
+        """Drop every compiled solver (root + shards) — the service's
+        boundedness lever; stickiness resets with them so placement
+        re-balances from scratch."""
+        root = self._parent or self
+        with root._lock:
+            root.solvers.clear()
+            for shard in root._shards.values():
+                shard.solvers.clear()
+            root._key_device.clear()
+
+    def note_iters(self, key, iters_p50: float) -> None:
+        """Feed a group's measured iteration count back into the rolling
+        per-structure baseline the elastic placement costs groups by."""
+        root = self._parent or self
+        with root._lock:
+            prev = root._iters_ewma.get(key)
+            root._iters_ewma[key] = (float(iters_p50) if prev is None
+                                     else 0.5 * prev + 0.5 * iters_p50)
+
+    def iters_hint(self, key) -> Optional[float]:
+        root = self._parent or self
+        with root._lock:
+            return root._iters_ewma.get(key)
 
 
 def batch_bucket(n: int) -> int:
@@ -1135,25 +1258,33 @@ class StagedGroupData:
 
 
 def stage_group_data(items, solver_opts, force: bool = False,
-                     pad_to: Optional[int] = None
+                     pad_to: Optional[int] = None, device=None
                      ) -> Optional[StagedGroupData]:
     """Stack + start uploading a verified subgroup's LP data (see
-    ``StagedGroupData``).  Single-accelerator only: the sharded path
-    reshards its inputs itself, and pre-staging to the default device
-    would just add a device->device hop.  ``force`` overrides the
+    ``StagedGroupData``).  Single-accelerator only — unless ``device``
+    pins the upload: the SHARDED path reshards its inputs itself, and
+    pre-staging to the default device would just add a device->device
+    hop, but the elastic per-device pipeline solves each group on ONE
+    named device and stages straight to it.  ``force`` overrides the
     device-count guard (unit tests run on a virtual multi-device mesh).
     ``pad_to`` applies the serving layer's bucket padding at stage time
     so the staged upload matches the shape the solver will run."""
     import jax
     from ..ops.pdhg import PDHGOptions
-    if (len(jax.devices()) > 1 or len(items) < 2) and not force:
+    if device is None:
+        if (len(jax.devices()) > 1 or len(items) < 2) and not force:
+            return None
+    elif len(items) < 2 and not pad_to:
+        # single-window groups ride the explicit solver.solve path,
+        # which takes the LP's own vectors — nothing to stage
         return None
     lps = [lp for (_, _, lp) in items]
     sdt = np.dtype((solver_opts or PDHGOptions()).dtype)
     t0 = time.perf_counter()
     arrs = _stack_group_data(lps, sdt, multi_dev=False, pad_to=pad_to)
     t1 = time.perf_counter()
-    dev = jax.device_put(arrs)
+    dev = (jax.device_put(arrs, device) if device is not None
+           else jax.device_put(arrs))
     t2 = time.perf_counter()
     return StagedGroupData(tuple(dev), t1 - t0, t2 - t1,
                            sum(a.nbytes for a in arrs))
@@ -1163,7 +1294,8 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
                 key=None, cache: Optional[SolverCache] = None, labels=None,
                 staged: Optional[StagedGroupData] = None, ledger=None,
                 ledger_meta=None, y_sink: Optional[dict] = None,
-                seeds=None, iterate_sink: Optional[dict] = None):
+                seeds=None, iterate_sink: Optional[dict] = None,
+                device=None):
     """Solve a group of structure-identical LPs.  Backend 'cpu' = exact
     HiGHS per instance; 'jax' = ONE batched PDHG device call, sharded over
     the scenario-axis mesh when more than one accelerator is visible
@@ -1236,7 +1368,10 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
     # subgroups to ONE cached solver from different workers, and a shared
     # solver.last_stats read-back would cross-wire their ledger entries
     stats = SolveStats()
-    multi_dev = len(jax.devices()) > 1
+    # a pinned ``device`` (elastic dispatch) keeps the group on ONE
+    # device-committed solver — the sharded mesh path is the GLOBAL
+    # scheduler's shape, not the per-device pipeline's
+    multi_dev = len(jax.devices()) > 1 and device is None
     n_mem = len(lps)
 
     # ---- warm-start plan: exact-hit substitution + iterate seeds ----
@@ -1331,8 +1466,10 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
                 # vector (a host .copy() would materialize the (B, m)
                 # block this collapse exists to avoid)
                 import jax.numpy as jnp
-                Q = jnp.broadcast_to(jax.device_put(Q),
-                                     (pad_to or len(lps_dev), Q.shape[0]))
+                Q = jnp.broadcast_to(
+                    jax.device_put(Q, device) if device is not None
+                    else jax.device_put(Q),
+                    (pad_to or len(lps_dev), Q.shape[0]))
             if multi_dev:
                 from ..parallel import scenario_mesh, solve_batch_sharded
                 res, _ = solve_batch_sharded(solver, scenario_mesh(),
@@ -1450,11 +1587,24 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
                 cold_iters.append(dev_it[row])
         if cold_iters:
             memory.note_cold_iters(key, cold_iters)
+    # rolling per-structure iteration baseline: the elastic scheduler's
+    # placement cost (windows x horizon x baseline) feeds from here
+    if cache is not None and key is not None and n_mem and \
+            (ledger_meta or {}).get("rung", "initial") in (None, "initial"):
+        cache.note_iters(key, float(np.percentile(iters_m, 50)))
     if ledger is not None:
         it = iters_m
+        from ..ops.pdhg import kernel_selection
+        kern, kern_why = kernel_selection(
+            solver, batched=not (len(lps_dev) == 1 and pad_to is None))
         entry = {**(ledger_meta or {}),
                  "backend": backend, "m": lp0.m, "n": lp0.n,
                  "batch": len(lps),
+                 # chosen chunk kernel + fallback reason (ROADMAP item 4:
+                 # BENCH_r03's silent scan fallback becomes a measured,
+                 # gateable observable)
+                 "kernel": kern,
+                 **({"kernel_fallback": kern_why} if kern_why else {}),
                  # single-window groups ride solver.solve even on a
                  # multi-device mesh — only real batches shard
                  "sharded": bool(multi_dev and len(lps_dev) > 1),
@@ -1766,7 +1916,7 @@ def _guarded_solve(watchdog, rung_desc: str, lps, labels, call):
 def resolve_group(items, backend: str, solver_opts, key=None,
                   cache: Optional[SolverCache] = None, watchdog=None,
                   staged: Optional[StagedGroupData] = None, ledger=None,
-                  board=None, policy=None):
+                  board=None, policy=None, device=None, ledger_tags=None):
     """Solve a window group with the per-window escalation ladder.
 
     ``items`` is a list of ``(scenario, ctx, lp)`` (structure-identical
@@ -1800,6 +1950,10 @@ def resolve_group(items, backend: str, solver_opts, key=None,
     meta = {"rung": "initial", "T": getattr(items[0][1], "T", None),
             "windows": len(items),
             "cases": len({id(s) for (s, _, _) in items})}
+    # elastic dispatch: device placement (+ steal marker) on the group's
+    # ledger entries — the axis the per-device slices are grouped by
+    if ledger_tags:
+        meta.update(ledger_tags)
     # serving layer: which requests' windows rode this group — the
     # observable that PROVES cross-request coalescing, and the key the
     # service slices per-request ledgers by
@@ -1835,7 +1989,8 @@ def resolve_group(items, backend: str, solver_opts, key=None,
         return solve_group(lps[0], lps, backend, solver_opts, key=key,
                            cache=cache, labels=labels, staged=staged,
                            ledger=local_ledger, ledger_meta=meta,
-                           y_sink=y_box, iterate_sink=iterate_sink)
+                           y_sink=y_box, iterate_sink=iterate_sink,
+                           device=device)
 
     (xs, objs, ok, diags, statuses), timed_out = _guarded_solve(
         watchdog, "initial", lps, labels, _call)
@@ -1915,7 +2070,8 @@ def resolve_group(items, backend: str, solver_opts, key=None,
         _escalate(items, fail_idx, xs, objs, ok, diags, statuses,
                   backend, solver_opts, key, cache, watchdog, ledger=ledger,
                   policy=policy, cert_rejected=cert_rejected, board=board,
-                  iterate_sink=iterate_sink)
+                  iterate_sink=iterate_sink, device=device,
+                  ledger_tags=ledger_tags)
     if policy.enabled and cert_rejected:
         # windows whose LAST certificate still rejected after the full
         # ladder: counted here (their case quarantines in apply_subgroup)
@@ -1941,7 +2097,7 @@ def resolve_group(items, backend: str, solver_opts, key=None,
 def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
               solver_opts, key, cache, watchdog=None, ledger=None,
               policy=None, cert_rejected=None, board=None,
-              iterate_sink=None) -> None:
+              iterate_sink=None, device=None, ledger_tags=None) -> None:
     """Escalation ladder for a group's failed members (mutates the result
     lists in place).
 
@@ -2071,8 +2227,10 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
                                key=rkey, cache=cache, labels=sub_labels,
                                ledger=retry_ledger,
                                ledger_meta={"rung": "retry",
-                                            "windows": len(sub_lps)},
-                               y_sink=retry_y_box, seeds=retry_seeds)
+                                            "windows": len(sub_lps),
+                                            **(ledger_tags or {})},
+                               y_sink=retry_y_box, seeds=retry_seeds,
+                               device=device)
 
         (rxs, robjs, rok, rdiags, rstatuses), r_timed_out = _guarded_solve(
             watchdog, "retry", sub_lps, sub_labels, _retry_call)
@@ -2202,7 +2360,7 @@ def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,
                 board.record("cpu_rung", res.status == 2)
     if ledger is not None and rung2_idx:
         ledger.append({"rung": "cpu_fallback", "backend": "cpu",
-                       "batch": len(rung2_idx),
+                       "batch": len(rung2_idx), **(ledger_tags or {}),
                        "solve_s": round(time.perf_counter() - t_rung2, 4)})
     # ladder wall time is attributed proportionally to each involved
     # case's failed-member count: the per-case values then SUM to the real
@@ -2343,6 +2501,23 @@ def summarize_solve_ledger(entries, dispatch_solve_s: float,
         out["iters"] = {"p50": int(np.percentile(it, 50)),
                         "p99": int(np.percentile(it, 99)),
                         "max": int(it.max())}
+    # kernel-selection observable (ROADMAP item 4): which chunk kernel
+    # each jax group actually rode, with fallback reasons aggregated —
+    # bench gates on a `runtime_disabled:` reason appearing where the
+    # fused kernel was eligible (the BENCH_r03 silent-fallback shape)
+    kernels = [e.get("kernel") for e in groups if e.get("kernel")]
+    if kernels:
+        from collections import Counter
+        reasons = Counter(e["kernel_fallback"] for e in groups
+                          if e.get("kernel_fallback"))
+        from ..ops import pallas_chunk as _pc
+        out["kernel"] = {
+            "pallas_chunk": sum(1 for k in kernels if k == "pallas_chunk"),
+            "xla_scan": sum(1 for k in kernels if k == "xla_scan"),
+            "fallback_reasons": dict(reasons),
+            "runtime_disabled": bool(_pc.RUNTIME_DISABLED),
+            "runtime_disabled_reason": _pc.RUNTIME_DISABLED_REASON,
+        }
     if warm_seen:
         # dispatch-level seeded-vs-cold split (initial rungs): the
         # published warm-start observable the smoke/bench gates read
@@ -2362,7 +2537,7 @@ def summarize_solve_ledger(entries, dispatch_solve_s: float,
 def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
                  checkpoint_dir=None, supervisor=None,
                  on_case_solved=None, solver_cache=None,
-                 breaker_board=None) -> None:
+                 breaker_board=None, elastic=None) -> None:
     """Dispatch driver over one or many cases (VERDICT r2 #3/#7).
 
     Replaces the reference's serial sensitivity for-loop
@@ -2400,7 +2575,17 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
 
     ``breaker_board`` (a ``utils.breaker.BreakerBoard``, service callers
     only) gates the escalation ladder's rungs through circuit breakers —
-    see ``resolve_group``.  None (solo runs) means no breakers."""
+    see ``resolve_group``.  None (solo runs) means no breakers.
+
+    ``elastic`` is the dispatch's device-placement axis: None (default)
+    follows the ``DERVET_TPU_ELASTIC`` env policy — on a multi-device
+    mesh, structure groups are placed across the devices and solved
+    concurrently (``parallel/elastic.py``); ``False`` forces the serial
+    global scheduler (one mesh-wide shard_map stream).  Callers whose
+    round is ONE wide structure group — the design screen's candidate
+    population — pass False: sharding that single batch over the whole
+    mesh beats placing it on one device, and the elastic scheduler has
+    nothing to schedule across."""
     from ..utils.errors import PreemptedError
     from ..utils import supervisor as _sup
     watchdog = (supervisor.watchdog if supervisor is not None
@@ -2446,7 +2631,7 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
         _dispatch_phases(scenarios, backend, solver_opts, watchdog,
                          _batch_boundary, on_case_solved,
                          solver_cache=solver_cache,
-                         breaker_board=breaker_board)
+                         breaker_board=breaker_board, elastic=elastic)
     except PreemptedError as e:
         # graceful shutdown: any batched-up checkpoint state is flushed
         # (only the degradation path batches writes, in strides of 8 —
@@ -2476,7 +2661,8 @@ def run_dispatch(scenarios, backend: str = "jax", solver_opts=None,
 
 def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
                      _batch_boundary, on_case_solved=None,
-                     solver_cache=None, breaker_board=None) -> None:
+                     solver_cache=None, breaker_board=None,
+                     elastic=None) -> None:
     """Phases 1 (structure-grouped) and 2 (degradation-stepped) of the
     batched dispatch; split out of ``run_dispatch`` so the preemption
     handler wraps exactly the interruptible region."""
@@ -2615,6 +2801,11 @@ def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
         return subgroups
 
     max_inflight = 0
+    elastic_stats = None
+    elastic_devs = None
+    if pipeline_on and backend != "cpu" and elastic is not False:
+        from ..parallel import elastic as _elastic
+        elastic_devs = _elastic.elastic_devices(backend)
     if backend == "cpu" or not pipeline_on:
         # the exact-CPU path, and the strict serial reference mode
         # (DERVET_TPU_PIPELINE=0): assemble, solve, scatter one subgroup
@@ -2626,6 +2817,83 @@ def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
             for k, its in split_exact(members).items():
                 scatter(its, solve_only(k, its)[1])
                 _batch_boundary()
+    elif elastic_devs is not None:
+        # ELASTIC multi-device dispatch (parallel/elastic.py): instead of
+        # driving the whole mesh through one serial stream of shard_map
+        # programs, structure groups are PLACED across the devices
+        # (estimated cost + compiled-program affinity) and each device
+        # runs its own in-flight pipeline — per-device solver-cache
+        # shard, per-device staged uploads, work stealing for stragglers.
+        # Each group solves as a single-device vmap program — the SAME
+        # program whatever the mesh size, so results are byte-identical
+        # across elastic schedules/placements/steals (asserted in
+        # tests/test_elastic.py; the legacy sharded path's bits vary
+        # with per-device batch width, so against it agreement is at
+        # certification tolerance).  Scatter + preemption boundaries
+        # stay on THIS thread, exactly like the pipeline.
+        max_inflight = len(elastic_devs)
+        sched = _elastic.ElasticScheduler(elastic_devs)
+
+        def _elastic_solve(device, dev_idx, task):
+            faultinject.maybe_straggle(dev_idx)
+            shard = cache.shard_for(device, dev_idx)
+            tags = {"device": dev_idx}
+            if task.stolen:
+                tags["stolen"] = True
+            t0 = time.perf_counter()
+            out = resolve_group(task.items, backend, solver_opts,
+                                key=task.key, cache=shard,
+                                watchdog=watchdog, staged=task.staged,
+                                ledger=ledger_entries, board=breaker_board,
+                                policy=cert_policy, device=device,
+                                ledger_tags=tags)
+            dt_ = time.perf_counter() - t0
+            with phase_lock:
+                phase_acc["solve_s"] += dt_
+            return out
+
+        def _elastic_stage(device, task):
+            t0 = time.perf_counter()
+            staged = stage_group_data(
+                task.items, solver_opts,
+                pad_to=_batch_pad_to(cache, len(task.items), False),
+                device=device)
+            with phase_lock:
+                phase_acc["stage_s"] += time.perf_counter() - t0
+            return staged
+
+        sched.start(_elastic_solve, _elastic_stage)
+        try:
+            while groups:
+                _, members = groups.popitem()
+                for k, its in split_exact(members).items():
+                    sched.submit(
+                        k, its,
+                        _elastic.estimate_group_cost(k, its, cache),
+                        affinity=cache.device_index_for(k))
+            sched.close_submissions()
+            # scatter in SUBMISSION order, not completion order: apply
+            # order drives the results surface's row order (objective/
+            # timeseries CSVs iterate insertion order), and completion
+            # order varies with device timing run to run.  Out-of-order
+            # completions buffer until their turn — the serial path's
+            # exact scatter sequence, reproduced.
+            done_buf: Dict[int, tuple] = {}
+            next_seq = 0
+            for task, result, err in sched.completions():
+                if err is not None:
+                    raise err
+                done_buf[task.seq] = (task, result)
+                while next_seq in done_buf:
+                    t, r = done_buf.pop(next_seq)
+                    next_seq += 1
+                    scatter(t.items, r)
+                    _batch_boundary()
+        finally:
+            # preemption/error: stop the workers (in-flight solves
+            # finish, queued groups are abandoned for the resume path)
+            sched.shutdown()
+        elastic_stats = sched.stats()
     else:
         # 2-stage pipeline: host LP assembly of group i overlaps the
         # device solve AND the XLA compiles of groups < i (compiles — the
@@ -2723,6 +2991,20 @@ def _dispatch_phases(scenarios, backend, solver_opts, watchdog,
 
     ledger = summarize_solve_ledger(ledger_entries, phase_acc["solve_s"],
                                     pipeline_on, max_inflight)
+    if elastic_stats is not None:
+        # per-device ledger slices: each device's group-entry walls must
+        # account for its busy wall the same way the global entries
+        # account for dispatch_solve_s (the PR-3 accounted_fraction
+        # gate, extended per device)
+        for dstr, rec in elastic_stats["devices"].items():
+            ent = [e for e in ledger["groups"]
+                   if str(e.get("device")) == dstr]
+            rec["solve_s"] = round(sum(float(e.get("solve_s", 0.0))
+                                       for e in ent), 4)
+            rec["accounted_fraction"] = (
+                round(rec["solve_s"] / rec["busy_s"], 4)
+                if rec["busy_s"] else None)
+        ledger["elastic"] = elastic_stats
     # numerical-trust line items ride the ledger too: per-run certificate
     # counts + certification/shadow wall time next to the device-traffic
     # decomposition they taxed

@@ -605,3 +605,18 @@ class BatchRound:
             "substituted_windows": int(
                 (led.get("warm_start") or {}).get("substituted", 0)),
         }
+        # elastic-scheduler observables (parallel/elastic.py): which
+        # devices this round's groups landed on, how many steals the
+        # stragglers cost, and the worst per-device occupancy — the
+        # serving-bench gate's raw material
+        el = led.get("elastic")
+        if el:
+            occ = [d["occupancy"] for d in el["devices"].values()
+                   if d["groups"]]
+            self.stats["elastic"] = {
+                "n_devices": el["n_devices"],
+                "devices_with_groups": el["devices_with_groups"],
+                "steals": el["n_steals"],
+                "min_occupancy": min(occ) if occ else None,
+                "round_wall_s": el["round_wall_s"],
+            }

@@ -302,8 +302,12 @@ class BackendRecovery:
         CPU failover."""
         if solver_cache is not None:
             # compiled programs + preconditioning hold dead-device
-            # buffers: drop them, the warm cache rebuilds on re-init
-            solver_cache.solvers.clear()
+            # buffers: drop them — including the elastic per-device
+            # shards — and the warm cache rebuilds on re-init
+            if hasattr(solver_cache, "clear"):
+                solver_cache.clear()
+            else:
+                solver_cache.solvers.clear()
         try:
             import jax
             try:
@@ -311,7 +315,12 @@ class BackendRecovery:
             except Exception:   # cache clearing is best-effort
                 pass
             from ..parallel.mesh import warmup_devices
-            info = warmup_devices()
+            # inventory-only probe: re-init must be FAST (it repeats up
+            # to max_reinits times against a possibly-dead backend, and
+            # time-to-CPU-failover scales with it); the per-device warm
+            # solves are a service-START cost, and the first elastic
+            # round after recovery rebuilds its shards anyway
+            info = warmup_devices(per_device_solve=False)
             # the injected device_loss fault also fails the warm-up
             # probe while armed, so N-consecutive-failure drills work
             from ..utils import faultinject

@@ -178,6 +178,11 @@ class ScenarioService:
                         "compile_events": 0, "round_s": 0.0,
                         "preempted": 0, "degraded_rounds": 0,
                         "seeded_windows": 0, "substituted_windows": 0}
+        # elastic-scheduler aggregates (parallel/elastic.py): rounds
+        # that rode the mesh-wide scheduler, total steals, worst
+        # per-device occupancy seen
+        self._elastic = {"rounds": 0, "steals": 0,
+                         "min_occupancy": None}
         self._requests = {"completed": 0, "failed": 0}
         self.last_round_ledger: Optional[Dict] = None
         self.device_info: Optional[Dict] = None
@@ -314,12 +319,21 @@ class ScenarioService:
         if self._started:
             return self
         if self.backend != "cpu":
+            from ..parallel import elastic
             from ..parallel.mesh import warmup_devices
-            self.device_info = warmup_devices()
+            # per-device warm solves only for the devices the elastic
+            # scheduler will actually place groups on — a serial (or
+            # single-device) service warms the default device alone
+            elastic_devs = elastic.elastic_devices(self.backend)
+            self.device_info = warmup_devices(
+                per_device_solve=elastic_devs is not None,
+                devices=elastic_devs)
             TellUser.info(
                 f"service: device warm ({self.device_info['n_devices']}x "
                 f"{self.device_info['platform']}:"
-                f"{self.device_info['device_kind']})")
+                f"{self.device_info['device_kind']}"
+                + (f", per-device warm-up {self.device_info['warmup_total_s']}s"
+                   if "warmup_total_s" in self.device_info else "") + ")")
         self._started = True
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="dervet-service-batcher")
@@ -509,6 +523,15 @@ class ScenarioService:
             self._rounds["batch_sum"] += float(
                 st.get("mean_batch", 0.0)) * int(st.get("device_groups", 0))
             self._rounds["round_s"] += float(st.get("round_s", 0.0))
+            el = st.get("elastic")
+            if el:
+                self._elastic["rounds"] += 1
+                self._elastic["steals"] += int(el.get("steals", 0))
+                mo = el.get("min_occupancy")
+                if mo is not None:
+                    prev = self._elastic["min_occupancy"]
+                    self._elastic["min_occupancy"] = (
+                        mo if prev is None else min(prev, mo))
         if rnd.ledger is not None:
             self.last_round_ledger = rnd.ledger
         if st.get("round_s"):
@@ -520,12 +543,13 @@ class ScenarioService:
         # structures must not grow device/host memory forever — clearing
         # trades a re-precondition for boundedness (same policy as the
         # structure-key memo)
-        if len(self.solver_cache.solvers) > self.max_cached_structures:
+        if self.solver_cache.structures_cached() > \
+                self.max_cached_structures:
             TellUser.warning(
                 f"service: solver cache at "
-                f"{len(self.solver_cache.solvers)} structures (bound "
-                f"{self.max_cached_structures}) — clearing")
-            self.solver_cache.solvers.clear()
+                f"{self.solver_cache.structures_cached()} structures "
+                f"(bound {self.max_cached_structures}) — clearing")
+            self.solver_cache.clear()
 
     def _absorb_request_outcomes(self, rnd: BatchRound) -> None:
         """Per-request accounting after delivery — including requests
@@ -598,6 +622,7 @@ class ScenarioService:
             rounds = dict(self._rounds)
             requests = dict(self._requests)
             design = dict(self._design)
+            elastic = dict(self._elastic)
         design["screen_s"] = round(design["screen_s"], 3)
         design["screen_candidates_per_s"] = round(
             design["candidates"] / design["screen_s"], 2) \
@@ -635,7 +660,7 @@ class ScenarioService:
                 "solver_hits": cache.hits,
                 "hit_rate": round(cache.hits / lookups, 4)
                 if lookups else None,
-                "structures_cached": len(cache.solvers),
+                "structures_cached": cache.structures_cached(),
                 "compile_events_total": rounds["compile_events"],
             },
             # warm-start solution memory (ops/warmstart.py): entry
@@ -646,6 +671,11 @@ class ScenarioService:
                         "started": self._started,
                         "draining": self._draining.is_set(),
                         "device": self.device_info},
+            # mesh-wide elastic scheduler (parallel/elastic.py): round/
+            # steal counts plus the last round's per-device slice
+            "elastic": {**elastic,
+                        "last_round": (self.last_round_ledger or {}
+                                       ).get("elastic")},
             # self-healing layer: breaker states, shed/degraded counts,
             # backend-loss recovery counters, poison quarantine
             "resilience": {

@@ -96,6 +96,19 @@ Two activation paths:
                                          corruption can only cost
                                          iterations, never correctness
                                          ('all' matches every window)
+      DERVET_TPU_FAULT_STRAGGLER=1       straggler DEVICE: every elastic
+                                         per-device solve on ONE device
+                                         (index DERVET_TPU_FAULT_
+                                         STRAGGLER_DEVICE, default 0) is
+                                         delayed by DERVET_TPU_FAULT_
+                                         STRAGGLER_S (default 0.75 s)
+                                         seconds — a deterministic slow
+                                         device, so the elastic
+                                         scheduler's work stealing is
+                                         drillable: the healthy devices
+                                         must steal the straggler's
+                                         queued groups and the round
+                                         must finish correct
       DERVET_TPU_FAULT_POISON=rid.0      poison-REQUEST crash: dispatching
                                          the targeted case raises an
                                          injected crash EVERY time it is
@@ -139,6 +152,7 @@ EVENT_OVERLOAD = "overload"         # service admission forced to reject
 EVENT_DEVICE_LOSS = "device_loss"   # backend death raised mid-solve
 EVENT_POISON_CASE = "poison_case"   # targeted case crashes its dispatch
 EVENT_STALE_SEED = "stale_seed"     # warm-start seed corrupted pre-solve
+EVENT_STRAGGLER = "straggler"       # one device's solves slowed (elastic)
 
 
 class InjectedCrashError(RuntimeError):
@@ -182,7 +196,10 @@ class FaultPlan:
                  device_loss_n: int = 1,
                  crash_cases: Iterable = (),
                  stale_seed: Iterable = (),
-                 stale_seed_scale: float = 0.5):
+                 stale_seed_scale: float = 0.5,
+                 straggler: bool = False,
+                 straggler_device: int = 0,
+                 straggler_seconds: float = 0.75):
         self.nonconverge = _norm(nonconverge)
         self.rungs = _norm(rungs)
         self.poison_cases = _norm(poison_cases)
@@ -225,6 +242,13 @@ class FaultPlan:
         # starts do) and deterministic per label
         self.stale_seed = _norm(stale_seed)
         self.stale_seed_scale = float(stale_seed_scale)
+        # straggler: slow every elastic solve on ONE device — the
+        # deterministic work-stealing drill (healthy devices must steal
+        # the slow device's queued groups; correctness is untouched
+        # because the delay is outside the solver)
+        self.straggler = bool(straggler)
+        self.straggler_device = int(straggler_device)
+        self.straggler_seconds = float(straggler_seconds)
         self._preempt_fired = False
         self.fired: List[Tuple[str, str]] = []   # (rung/event, label/case)
 
@@ -303,6 +327,15 @@ class FaultPlan:
             return True
         return False
 
+    def straggler_delay(self, device_index: int) -> float:
+        """Seconds an elastic solve on device ``device_index`` should be
+        delayed (0 when the straggler fault is off or targets another
+        device)."""
+        if not self.straggler or int(device_index) != self.straggler_device:
+            return 0.0
+        self.fired.append((EVENT_STRAGGLER, str(device_index)))
+        return self.straggler_seconds
+
     def should_crash(self, case_id) -> bool:
         if _match(self.crash_cases, case_id):
             self.fired.append((EVENT_POISON_CASE, str(case_id)))
@@ -335,7 +368,10 @@ _ENV_VARS = ("DERVET_TPU_FAULT_NONCONVERGE", "DERVET_TPU_FAULT_POISON_CASE",
              "DERVET_TPU_FAULT_DEVICE_LOSS_AFTER",
              "DERVET_TPU_FAULT_DEVICE_LOSS_N", "DERVET_TPU_FAULT_POISON",
              "DERVET_TPU_FAULT_STALE_SEED",
-             "DERVET_TPU_FAULT_STALE_SEED_SCALE")
+             "DERVET_TPU_FAULT_STALE_SEED_SCALE",
+             "DERVET_TPU_FAULT_STRAGGLER",
+             "DERVET_TPU_FAULT_STRAGGLER_DEVICE",
+             "DERVET_TPU_FAULT_STRAGGLER_S")
 _ENV_PLAN: Optional[FaultPlan] = None
 _ENV_SNAPSHOT: Optional[tuple] = None
 
@@ -354,8 +390,10 @@ def _plan_from_env() -> Optional[FaultPlan]:
     dl_on = dl not in ("", "0", "false", "off")
     crash = os.environ.get("DERVET_TPU_FAULT_POISON")
     ss = os.environ.get("DERVET_TPU_FAULT_STALE_SEED")
+    st = os.environ.get("DERVET_TPU_FAULT_STRAGGLER", "").strip().lower()
+    st_on = st not in ("", "0", "false", "off")
     if not (nc or pc or cf or hg or sl or pa or cr or ov_on or dl_on
-            or crash or ss):
+            or crash or ss or st_on):
         return None
     ov_n = os.environ.get("DERVET_TPU_FAULT_OVERLOAD_N")
     rungs = os.environ.get("DERVET_TPU_FAULT_RUNGS", RUNG_SOLVE)
@@ -380,7 +418,12 @@ def _plan_from_env() -> Optional[FaultPlan]:
         crash_cases=crash or (),
         stale_seed=ss or (),
         stale_seed_scale=float(
-            os.environ.get("DERVET_TPU_FAULT_STALE_SEED_SCALE", 0.5)))
+            os.environ.get("DERVET_TPU_FAULT_STALE_SEED_SCALE", 0.5)),
+        straggler=st_on,
+        straggler_device=int(
+            os.environ.get("DERVET_TPU_FAULT_STRAGGLER_DEVICE", 0)),
+        straggler_seconds=float(
+            os.environ.get("DERVET_TPU_FAULT_STRAGGLER_S", 0.75)))
 
 
 def get_plan() -> Optional[FaultPlan]:
@@ -497,6 +540,20 @@ def maybe_device_loss() -> None:
     if plan is not None and plan.device_loss_due():
         raise DeviceLossError(
             "fault injection: device loss — backend died mid-solve")
+
+
+def maybe_straggle(device_index: int) -> float:
+    """``straggler`` injection point at the top of an elastic per-device
+    solve: when this worker's device is the targeted straggler, sleep —
+    the deterministic slow-device drill for the work-stealing path.
+    Returns the seconds slept (0 in the no-plan fast path)."""
+    plan = get_plan()
+    if plan is None:
+        return 0.0
+    secs = plan.straggler_delay(device_index)
+    if secs > 0:
+        time.sleep(secs)
+    return secs
 
 
 def maybe_crash_case(case_id) -> None:

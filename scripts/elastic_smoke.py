@@ -1,0 +1,185 @@
+"""CI smoke: elastic mesh serving on 8 forced host devices, no chip.
+
+Boots the :class:`~dervet_tpu.service.server.ScenarioService` on an
+8-virtual-device CPU XLA mesh and drills the elastic scheduler
+(parallel/elastic.py) end to end:
+
+* N concurrent requests with DIFFERENT window lengths fan one round out
+  to > 8 structure groups — every device must receive at least one
+  group (mesh-wide placement actually happened);
+* results are BYTE-IDENTICAL to a single-device elastic schedule
+  (``DERVET_TPU_ELASTIC_DEVICES=1``) on a fresh service — objectives
+  and the full solution-array surface: placement, mesh size, and
+  stealing never change what a window solves to;
+* 100% of windows carry an accepted float64 certificate;
+* a warm repeat round compiles NOTHING (the per-device shard caches +
+  warm-start memory keep the zero-compile hot-serving contract);
+* under the ``straggler`` fault (device 0 slowed), a fresh round records
+  >= 1 work steal and still completes correct.
+
+Env knobs: SMOKE_ELASTIC_LENGTHS (default 10 distinct window lengths),
+SMOKE_ELASTIC_CASES (cases per request, default 2).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _workload(n_lengths: int, cases_per: int):
+    from dervet_tpu.benchlib import synthetic_sensitivity_cases
+    out = {}
+    for i in range(n_lengths):
+        n = 72 + 24 * i
+        cases = synthetic_sensitivity_cases(cases_per, months=1, n=n)
+        out[f"el{i}"] = {j: c for j, c in enumerate(cases)}
+    return out
+
+
+def _serve(workload, rid_prefix=""):
+    """Submit the whole workload, then drive ONE deterministic
+    ``run_once`` round (no batcher thread: a background round could
+    split the wave and leave ``last_round_ledger`` covering only the
+    tail — the device-coverage assertions need the full round)."""
+    from dervet_tpu.service import ScenarioService
+    svc = ScenarioService(backend="jax", max_wait_s=0.0,
+                          max_batch_requests=64)
+    try:
+        futs = {rid: svc.submit(cases, request_id=f"{rid_prefix}{rid}")
+                for rid, cases in workload.items()}
+        svc.run_once()
+        results = {rid: f.result(timeout=900) for rid, f in futs.items()}
+        return svc, results
+    except BaseException:
+        svc.close()
+        raise
+
+
+def main() -> int:
+    import numpy as np
+
+    from dervet_tpu.benchlib import validate_solve_ledger
+
+    n_lengths = int(os.environ.get("SMOKE_ELASTIC_LENGTHS", "10"))
+    cases_per = int(os.environ.get("SMOKE_ELASTIC_CASES", "2"))
+    n_dev = len(jax.devices())
+    assert n_dev == 8, f"smoke expects 8 forced host devices, got {n_dev}"
+
+    # -- elastic pass ---------------------------------------------------
+    os.environ.pop("DERVET_TPU_ELASTIC", None)
+    svc, results = _serve(_workload(n_lengths, cases_per))
+    try:
+        ledger = svc.last_round_ledger
+        validate_solve_ledger(ledger)
+        el = ledger.get("elastic")
+        if not el:
+            raise AssertionError("no elastic section in the round ledger")
+        if el["devices_with_groups"] != n_dev:
+            raise AssertionError(
+                f"only {el['devices_with_groups']}/{n_dev} devices "
+                f"received groups: {el['devices']}")
+        total_windows = 0
+        for rid, res in results.items():
+            cert = res.run_health["certification"]
+            n_windows = sum(len(inst.scenario.windows)
+                            for inst in res.instances.values())
+            total_windows += n_windows
+            if not cert["enabled"] or \
+                    cert["windows_certified"] != n_windows:
+                raise AssertionError(
+                    f"{rid}: {cert['windows_certified']}/{n_windows} "
+                    "windows certified (acceptance: 100%)")
+
+        # warm repeat: identical workload, zero compiles anywhere
+        futs = {rid: svc.submit(cases, request_id=f"warm.{rid}")
+                for rid, cases in _workload(n_lengths, cases_per).items()}
+        svc.run_once()
+        for f in futs.values():
+            f.result(timeout=900)
+        warm_compiles = svc.last_round_ledger["totals"]["compile_events"]
+        if warm_compiles:
+            raise AssertionError(
+                f"warm elastic round compiled {warm_compiles} program(s) "
+                "— the zero-compile hot-serving contract is broken")
+        metrics = svc.metrics()
+    finally:
+        svc.close()
+
+    # -- single-device schedule: byte identity ---------------------------
+    os.environ["DERVET_TPU_ELASTIC_DEVICES"] = "1"
+    try:
+        svc_s, results_s = _serve(_workload(n_lengths, cases_per))
+        svc_s.close()
+    finally:
+        os.environ.pop("DERVET_TPU_ELASTIC_DEVICES", None)
+    for rid, res in results.items():
+        ref = results_s[rid]
+        for key in res.instances:
+            se = res.instances[key].scenario
+            ss = ref.instances[key].scenario
+            if se.objective_values != ss.objective_values:
+                raise AssertionError(f"objective mismatch {rid}/{key}")
+            for name in se._solution:
+                if not np.array_equal(se._solution[name],
+                                      ss._solution[name]):
+                    raise AssertionError(
+                        f"solution mismatch {rid}/{key}/{name}")
+
+    # -- straggler drill: device 0 slowed, >= 1 steal --------------------
+    os.environ["DERVET_TPU_FAULT_STRAGGLER"] = "1"
+    os.environ["DERVET_TPU_FAULT_STRAGGLER_DEVICE"] = "0"
+    os.environ["DERVET_TPU_FAULT_STRAGGLER_S"] = "0.6"
+    try:
+        svc_f, results_f = _serve(_workload(n_lengths, cases_per))
+        try:
+            led_f = svc_f.last_round_ledger
+            el_f = led_f.get("elastic") or {}
+            if not el_f.get("n_steals"):
+                raise AssertionError(
+                    f"no work steal under the straggler fault: {el_f}")
+            for rid, res in results_f.items():
+                cert = res.run_health["certification"]
+                n_windows = sum(len(inst.scenario.windows)
+                                for inst in res.instances.values())
+                if cert["windows_certified"] != n_windows:
+                    raise AssertionError(
+                        f"straggler drill: {rid} lost certification")
+        finally:
+            svc_f.close()
+    finally:
+        for k in ("DERVET_TPU_FAULT_STRAGGLER",
+                  "DERVET_TPU_FAULT_STRAGGLER_DEVICE",
+                  "DERVET_TPU_FAULT_STRAGGLER_S"):
+            os.environ.pop(k, None)
+
+    print(json.dumps({
+        "smoke": "elastic", "ok": True,
+        "devices": n_dev,
+        "requests": n_lengths,
+        "windows": total_windows,
+        "devices_with_groups": el["devices_with_groups"],
+        "placement_steals": el["n_steals"],
+        "straggler_steals": el_f["n_steals"],
+        "warm_repeat_compile_events": warm_compiles,
+        "occupancy": {d: rec["occupancy"]
+                      for d, rec in el["devices"].items()},
+        "elastic_metrics": metrics["elastic"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,495 @@
+"""Elastic multi-device dispatch: the mesh-wide group scheduler
+(parallel/elastic.py) with per-device in-flight rounds and work stealing.
+
+The contract under test:
+
+* elastic results are BYTE-IDENTICAL across 1/2/8-device schedules,
+  placements, and steals — the scheduler changes WHERE a window solves,
+  never what it solves to.  (The legacy serial path never had this
+  property: its shard_map program's per-device batch width — and with
+  it the XLA reduction order of dense-op matmuls — changes with the
+  visible device count, so its bits depend on the host.  Elastic solves
+  every group with the same single-device batched program regardless of
+  mesh size, so its bits do not.)  Against the serial global scheduler
+  (``DERVET_TPU_ELASTIC=0``) results agree within certification
+  tolerance, with banded-op groups typically bit-equal;
+* the ``straggler`` fault (one slow device) is recovered by work
+  stealing: healthy devices take the straggler's queued groups and the
+  round finishes correct;
+* a SIGTERM mid-elastic-round drains exactly like the serial path:
+  checkpoints + manifest flush, and a resume run completes with
+  identical outputs;
+* the solve ledger grows a per-device elastic slice whose entries
+  account for each device's busy wall (the PR-3 ``accounted_fraction``
+  gate, per device), plus the chosen-kernel observable per group;
+* ``parallel.mesh.warmup_devices`` warms EVERY device with a tiny
+  bucket-shaped solve and reports per-device timings.
+"""
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from dervet_tpu.benchlib import synthetic_sensitivity_cases
+from dervet_tpu.parallel import elastic
+from dervet_tpu.scenario.scenario import (MicrogridScenario, SolverCache,
+                                          run_dispatch)
+from dervet_tpu.utils import faultinject
+
+ELASTIC_ENVS = (elastic.ELASTIC_ENV, elastic.ELASTIC_DEVICES_ENV)
+
+
+def _clear_env():
+    for k in ELASTIC_ENVS:
+        os.environ.pop(k, None)
+
+
+def _mixed_cases(lengths=(96, 168, 120)):
+    """A workload whose window LENGTHS differ across requests: distinct
+    window hours -> distinct structure groups (plus their tail-window
+    remainders), so a multi-device round has several groups to
+    place/steal (synthetic month cases alone collapse to a couple of
+    month-length groups)."""
+    import dataclasses
+    cases = []
+    for i, n in enumerate(lengths):
+        for c in synthetic_sensitivity_cases(1, months=1, n=n, seed=i):
+            cases.append(dataclasses.replace(c, case_id=f"w{n}.{c.case_id}"))
+    return cases
+
+
+def _dispatch(env=None, lengths=(96, 168, 120)):
+    prev = {k: os.environ.get(k) for k in ELASTIC_ENVS}
+    _clear_env()
+    try:
+        for k, v in (env or {}).items():
+            os.environ[k] = v
+        scens = [MicrogridScenario(c) for c in _mixed_cases(lengths)]
+        run_dispatch(scens, backend="jax")
+        return scens
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.fixture(scope="module")
+def elastic_run():
+    """The mixed workload through the DEFAULT elastic scheduler (8
+    virtual devices, conftest)."""
+    return _dispatch()
+
+
+@pytest.fixture(scope="module")
+def single_run():
+    """The identical workload on a SINGLE-device elastic schedule — the
+    scheduler-invariance bit reference."""
+    return _dispatch({elastic.ELASTIC_DEVICES_ENV: "1"})
+
+
+@pytest.fixture(scope="module")
+def serial_run():
+    """The identical workload through the legacy serial global
+    scheduler (one shard_map stream over the whole mesh)."""
+    return _dispatch({elastic.ELASTIC_ENV: "0"})
+
+
+def _assert_identical(a_scens, b_scens):
+    for sa, sb in zip(a_scens, b_scens):
+        assert sa.quarantine is None and sb.quarantine is None
+        assert sa.objective_values == sb.objective_values
+        assert set(sa._solution) == set(sb._solution)
+        for name in sa._solution:
+            assert np.array_equal(sa._solution[name], sb._solution[name]), \
+                (sa.case.case_id, name)
+
+
+def _assert_close(a_scens, b_scens, obj_rtol=1e-5, x_atol=0.05):
+    for sa, sb in zip(a_scens, b_scens):
+        for w in sa.objective_values:
+            oa = sa.objective_values[w]["Total Objective"]
+            ob = sb.objective_values[w]["Total Objective"]
+            assert abs(oa - ob) <= obj_rtol * max(1.0, abs(ob)), (w, oa, ob)
+        for name in sa._solution:
+            assert np.allclose(sa._solution[name], sb._solution[name],
+                               atol=x_atol, rtol=1e-3), name
+
+
+# ---------------------------------------------------------------------------
+# Scheduler invariance: bits never depend on the schedule
+# ---------------------------------------------------------------------------
+
+class TestSchedulerInvariance:
+    def test_eight_devices_available(self):
+        assert len(jax.devices()) >= 8
+
+    def test_eight_vs_single_device_schedule_bitwise(self, elastic_run,
+                                                     single_run):
+        _assert_identical(elastic_run, single_run)
+
+    def test_two_device_schedule_bitwise(self, single_run):
+        scens = _dispatch({elastic.ELASTIC_DEVICES_ENV: "2"})
+        _assert_identical(scens, single_run)
+
+    def test_serial_scheduler_within_certification_tolerance(
+            self, elastic_run, serial_run):
+        """The legacy sharded path's bits vary with per-device batch
+        width (dense-op XLA reduction order), so cross-SCHEDULER
+        equality is tolerance-level; every window on both sides holds
+        an accepted float64 certificate."""
+        _assert_close(elastic_run, serial_run)
+
+    def test_elastic_run_fully_certified(self, elastic_run):
+        for s in elastic_run:
+            cert = s.certification
+            assert cert["rejected_final"] == 0
+            assert cert["certified"] + cert["certified_loose"] \
+                == len(s.windows)
+
+    def test_serial_run_has_no_elastic_section(self, serial_run):
+        led = serial_run[0].solve_metadata["solve_ledger"]
+        assert "elastic" not in led
+
+
+# ---------------------------------------------------------------------------
+# The elastic ledger slice: placement, occupancy, per-device accounting
+# ---------------------------------------------------------------------------
+
+class TestElasticLedger:
+    def test_elastic_section_schema(self, elastic_run):
+        led = elastic_run[0].solve_metadata["solve_ledger"]
+        el = led["elastic"]
+        assert el["n_devices"] == len(jax.devices())
+        assert el["round_wall_s"] > 0
+        assert el["devices_with_groups"] >= 2   # the round actually fanned out
+        assert len(el["devices"]) == el["n_devices"]
+
+    def test_group_entries_carry_device_axis(self, elastic_run):
+        led = elastic_run[0].solve_metadata["solve_ledger"]
+        initial = [g for g in led["groups"] if g.get("rung") == "initial"]
+        assert initial
+        for g in initial:
+            assert isinstance(g["device"], int)
+
+    def test_per_device_slices_account_for_busy_wall(self, elastic_run):
+        """The per-device extension of the PR-3 accounted_fraction gate:
+        each device's group-entry walls must explain its busy wall, and
+        no device can be busier than the round."""
+        led = elastic_run[0].solve_metadata["solve_ledger"]
+        el = led["elastic"]
+        for d, rec in el["devices"].items():
+            if not rec["groups"]:
+                continue
+            assert rec["busy_s"] <= el["round_wall_s"] * 1.05
+            assert rec["accounted_fraction"] is not None
+            assert 0.5 <= rec["accounted_fraction"] <= 1.05, (d, rec)
+
+    def test_device_windows_sum_to_round(self, elastic_run):
+        led = elastic_run[0].solve_metadata["solve_ledger"]
+        el = led["elastic"]
+        total = sum(rec["windows"] for rec in el["devices"].values())
+        assert total == led["totals"]["windows"]
+
+    def test_kernel_choice_recorded_per_group(self, elastic_run):
+        led = elastic_run[0].solve_metadata["solve_ledger"]
+        initial = [g for g in led["groups"] if g.get("rung") == "initial"]
+        for g in initial:
+            assert g["kernel"] in ("pallas_chunk", "xla_scan")
+            if g["kernel"] == "xla_scan":
+                assert g.get("kernel_fallback")   # reason always named
+        kern = led["kernel"]
+        assert kern["pallas_chunk"] + kern["xla_scan"] >= len(initial)
+        # the cpu host platform is an EXPECTED scan reason, never a
+        # runtime_disabled regression
+        assert not any(r.startswith("runtime_disabled")
+                       for r in kern["fallback_reasons"])
+        assert kern["runtime_disabled"] is False
+
+    def test_kernel_fallback_gate(self):
+        """bench.check_kernel_gate: expected scan reasons pass, a
+        runtime_disabled reason fails the leg."""
+        import bench
+        bench.check_kernel_gate(None, "t")
+        bench.check_kernel_gate(
+            {"kernel": {"fallback_reasons":
+                        {"backend 'cpu' (kernel is TPU-only)": 3}}}, "t")
+        with pytest.raises(SystemExit):
+            bench.check_kernel_gate(
+                {"kernel": {"fallback_reasons":
+                            {"runtime_disabled: scoped vmem": 1}}}, "t")
+
+
+# ---------------------------------------------------------------------------
+# Scheduler unit tests (no device work)
+# ---------------------------------------------------------------------------
+
+class TestScheduler:
+    def test_env_parsing(self, monkeypatch):
+        monkeypatch.setenv(elastic.ELASTIC_ENV, "0")
+        assert elastic.elastic_devices("jax") is None
+        monkeypatch.setenv(elastic.ELASTIC_ENV, "1")
+        assert elastic.elastic_devices("cpu") is None
+        devs = elastic.elastic_devices("jax")
+        assert devs is not None and len(devs) == len(jax.devices())
+        monkeypatch.setenv(elastic.ELASTIC_DEVICES_ENV, "2")
+        assert len(elastic.elastic_devices("jax")) == 2
+        monkeypatch.setenv(elastic.ELASTIC_DEVICES_ENV, "1")
+        assert len(elastic.elastic_devices("jax")) == 1
+
+    def test_cost_estimate_uses_ledger_baseline(self):
+        cache = SolverCache()
+        items = [(None, type("Ctx", (), {"T": 10})(), None)] * 4
+        cold = elastic.estimate_group_cost("k1", items, cache)
+        assert cold == 4 * 10 * elastic.DEFAULT_ITERS_BASELINE
+        cache.note_iters("k1", 2000.0)
+        assert elastic.estimate_group_cost("k1", items, cache) \
+            == 4 * 10 * 2000.0
+        # EWMA: feedback converges toward the latest measurement
+        cache.note_iters("k1", 1000.0)
+        assert cache.iters_hint("k1") == 1500.0
+
+    def test_lpt_placement_balances_cost(self):
+        sched = elastic.ElasticScheduler(["d0", "d1", "d2"])
+        for i, cost in enumerate((100.0, 90.0, 50.0, 40.0, 30.0)):
+            sched.submit(f"k{i}", [None], cost)
+        assert sorted(sched.placed_cost) == [90.0, 100.0, 120.0]
+
+    def test_affinity_overrides_balance(self):
+        sched = elastic.ElasticScheduler(["d0", "d1"])
+        sched.submit("k0", [None], 100.0)
+        sched.submit("k1", [None], 100.0, affinity=0)
+        assert sched.placed_cost == [200.0, 0.0]
+
+    def test_workers_solve_and_steal_from_busy_straggler(self):
+        """4 groups over 2 fake devices; device 0's solves are slow, so
+        device 1 must steal device 0's queued group while 0 is busy —
+        and every group still completes exactly once."""
+        import time as _t
+        sched = elastic.ElasticScheduler(["slow", "fast"])
+
+        def solve(device, idx, task):
+            _t.sleep(0.5 if device == "slow" else 0.05)
+            return ("done", task.key)
+
+        for i, cost in enumerate((100.0, 99.0, 98.0, 97.0)):
+            sched.submit(f"k{i}", [None], cost)
+        sched.start(solve)
+        sched.close_submissions()
+        done = []
+        for task, result, err in sched.completions():
+            assert err is None
+            done.append(result[1])
+        sched.shutdown()
+        assert sorted(done) == ["k0", "k1", "k2", "k3"]
+        st = sched.stats()
+        assert st["n_steals"] >= 1
+        assert st["devices"]["1"]["steals_in"] >= 1
+        assert st["devices"]["0"]["steals_out"] >= 1
+
+    def test_idle_victim_is_not_stolen_from(self):
+        """A group queued on an idle device belongs to that device — a
+        steal would move it off its warm compiled-program shard for
+        nothing (the phantom-steal hazard that broke the hot service's
+        zero-compile round)."""
+        import time as _t
+        sched = elastic.ElasticScheduler(["a", "b"])
+        order = []
+
+        def solve(device, idx, task):
+            order.append((task.key, device))
+            _t.sleep(0.02)
+            return "ok"
+
+        sched.submit("k0", [None], 10.0, affinity=0)
+        sched.start(solve)
+        _t.sleep(0.3)
+        sched.close_submissions()
+        list(sched.completions())
+        sched.shutdown()
+        assert order == [("k0", "a")]
+        assert sched.stats()["n_steals"] == 0
+
+    def test_worker_error_propagates(self):
+        sched = elastic.ElasticScheduler(["d0"])
+
+        def solve(device, idx, task):
+            raise RuntimeError("boom")
+
+        sched.submit("k0", [None], 1.0)
+        sched.start(solve)
+        sched.close_submissions()
+        (task, result, err), = list(sched.completions())
+        sched.shutdown()
+        assert isinstance(err, RuntimeError)
+
+
+# ---------------------------------------------------------------------------
+# Straggler fault -> work stealing, end to end
+# ---------------------------------------------------------------------------
+
+class TestStragglerDrill:
+    def test_env_knobs_parse(self, monkeypatch):
+        monkeypatch.setenv("DERVET_TPU_FAULT_STRAGGLER", "1")
+        monkeypatch.setenv("DERVET_TPU_FAULT_STRAGGLER_DEVICE", "3")
+        monkeypatch.setenv("DERVET_TPU_FAULT_STRAGGLER_S", "0.25")
+        plan = faultinject.get_plan()
+        assert plan is not None and plan.straggler
+        assert plan.straggler_device == 3
+        assert plan.straggler_delay(3) == 0.25
+        assert plan.straggler_delay(1) == 0.0
+        assert (faultinject.EVENT_STRAGGLER, "3") in plan.fired
+
+    def test_straggler_is_stolen_from_and_results_correct(self, single_run):
+        """End to end: device 0 slowed on a 2-device schedule; the round
+        must record >= 1 steal, finish every window, and stay
+        byte-identical to the straggler-free single-device schedule
+        (stealing moves groups, never changes results)."""
+        prev = {k: os.environ.get(k) for k in ELASTIC_ENVS}
+        _clear_env()
+        try:
+            os.environ[elastic.ELASTIC_DEVICES_ENV] = "2"
+            with faultinject.inject(straggler=True, straggler_device=0,
+                                    straggler_seconds=0.6) as plan:
+                scens = [MicrogridScenario(c) for c in _mixed_cases()]
+                run_dispatch(scens, backend="jax")
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        assert any(ev == faultinject.EVENT_STRAGGLER
+                   for ev, _ in plan.fired)
+        led = scens[0].solve_metadata["solve_ledger"]
+        el = led["elastic"]
+        assert el["n_devices"] == 2
+        assert el["n_steals"] >= 1, el
+        assert el["devices"]["1"]["steals_in"] >= 1
+        stolen = [g for g in led["groups"] if g.get("stolen")]
+        assert stolen and all(g["device"] == 1 for g in stolen)
+        _assert_identical(scens, single_run)
+
+
+class TestEscalationUnderElastic:
+    def test_retry_rung_runs_on_the_groups_device(self):
+        """Forced non-convergence inside an elastic round: the boosted-
+        budget retry re-solves the failed members on the SAME device
+        (the shard that holds the group's solver), recoveries land in
+        health['retried'], and retry ledger entries carry the device
+        tag."""
+        scens = [MicrogridScenario(c) for c in _mixed_cases((96,))]
+        with faultinject.inject(nonconverge="all", rungs={"solve"}):
+            run_dispatch(scens, backend="jax")
+        s = scens[0]
+        assert s.quarantine is None
+        assert s.health["retried"] == len(s.windows)
+        led = s.solve_metadata["solve_ledger"]
+        retries = [g for g in led["groups"] if g.get("rung") == "retry"]
+        assert retries
+        # batch sizes are unique per group in this workload (7 + 1), so
+        # the retry pairs with its initial rung by batch width
+        by_rung = {}
+        for g in led["groups"]:
+            if g.get("rung") in ("initial", "retry"):
+                by_rung.setdefault(g["batch"], {})[g["rung"]] = \
+                    g.get("device")
+        paired = [r for r in by_rung.values()
+                  if "retry" in r and "initial" in r]
+        assert paired
+        for rungs in paired:
+            assert rungs["retry"] == rungs["initial"]
+
+
+# ---------------------------------------------------------------------------
+# Drain mid-elastic-round -> resume
+# ---------------------------------------------------------------------------
+
+class TestDrainMidElasticRound:
+    def test_preempt_flushes_and_resume_completes(self, tmp_path,
+                                                  single_run):
+        """SIGTERM after the first elastic batch boundary: the round
+        stops, checkpoints + the resume manifest flush, and a second run
+        with the same checkpoint_dir finishes with outputs identical to
+        the uninterrupted elastic reference."""
+        import json
+
+        from dervet_tpu.utils import supervisor as sup
+        from dervet_tpu.utils.errors import PreemptedError
+
+        scns = [MicrogridScenario(c) for c in _mixed_cases()]
+        with faultinject.inject(preempt_after=1) as plan:
+            with sup.RunSupervisor() as rs:
+                with pytest.raises(PreemptedError):
+                    run_dispatch(scns, backend="jax",
+                                 checkpoint_dir=tmp_path, supervisor=rs)
+        assert ("preempt", "1") in plan.fired
+        manifest = json.loads(sup.manifest_path(tmp_path).read_text())
+        assert manifest["cases"]
+
+        scns2 = [MicrogridScenario(c) for c in _mixed_cases()]
+        run_dispatch(scns2, backend="jax", checkpoint_dir=tmp_path)
+        _assert_identical(scns2, single_run)
+
+
+# ---------------------------------------------------------------------------
+# Per-device warm-up
+# ---------------------------------------------------------------------------
+
+class TestWarmupDevices:
+    def test_every_device_warmed_with_timings(self):
+        from dervet_tpu.parallel.mesh import warmup_devices
+        info = warmup_devices()
+        n = len(jax.devices())
+        assert info["n_devices"] == n
+        assert len(info["warmup_s"]) == n
+        assert all(v > 0 for v in info["warmup_s"].values())
+        assert info["warmup_total_s"] >= max(info["warmup_s"].values())
+
+    def test_inventory_only_mode(self):
+        from dervet_tpu.parallel.mesh import warmup_devices
+        info = warmup_devices(per_device_solve=False)
+        assert "warmup_s" not in info and info["n_devices"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Per-device solver-cache shards
+# ---------------------------------------------------------------------------
+
+class TestCacheShards:
+    def test_shards_share_memory_and_mirror_counters(self):
+        from dervet_tpu.ops.warmstart import SolutionMemory
+        mem = SolutionMemory()
+        root = SolverCache(pad_grid=True, memory=mem)
+        d0, d1 = jax.devices()[:2]
+        s0 = root.shard_for(d0, 0)
+        s1 = root.shard_for(d1, 1)
+        assert s0 is root.shard_for(d0, 0)      # persistent
+        assert s0.memory is mem and s1.memory is mem
+        assert s0.pad_grid and s1.pad_grid
+
+    def test_shard_builds_are_sticky_and_cloned_cross_device(self):
+        from tests.test_pdhg import battery_like_lp
+        lp = battery_like_lp(T=16)
+        root = SolverCache()
+        d0, d1 = jax.devices()[:2]
+        s0 = root.shard_for(d0, 0)
+        solver0 = s0.get("k", lp, None)
+        assert root.builds == 1
+        assert root.device_index_for("k") == 0
+        assert list(solver0.op.Kh.devices() if hasattr(solver0.op, "Kh")
+                    else solver0.dr.devices()) == [d0]
+        # second shard clones the preconditioning instead of rebuilding
+        s1 = root.shard_for(d1, 1)
+        solver1 = s1.get("k", lp, None)
+        assert solver1 is not solver0
+        assert list(solver1.dr.devices()) == [d1]
+        assert root.builds == 2                 # honest count, no Ruiz rerun
+        assert np.array_equal(np.asarray(solver0.dr),
+                              np.asarray(solver1.dr))
+        assert root.structures_cached() == 1    # one structure, two shards
+        root.clear()
+        assert root.structures_cached() == 0
+        assert root.device_index_for("k") is None

@@ -183,11 +183,15 @@ class TestLargeShardedDispatch:
 
     N_CASES = 26     # x 12 monthly windows = 312 window-LPs, 3 groups
 
-    def test_hundreds_of_instances_through_pipeline(self):
+    def test_hundreds_of_instances_through_pipeline(self, monkeypatch):
         from dervet_tpu.benchlib import (synthetic_sensitivity_cases,
                                          validate_solve_ledger)
         from dervet_tpu.scenario.scenario import (MicrogridScenario,
                                                   run_dispatch)
+        # this test exercises the SHARDED solve x pipeline interaction
+        # specifically — the elastic scheduler (its own test file) would
+        # route these groups to per-device solves instead
+        monkeypatch.setenv("DERVET_TPU_ELASTIC", "0")
         scens = [MicrogridScenario(c)
                  for c in synthetic_sensitivity_cases(self.N_CASES)]
         run_dispatch(scens, backend="jax")
