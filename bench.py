@@ -263,6 +263,11 @@ def main() -> None:
             legs["design"] = design_leg()
         except Exception as e:          # noqa: BLE001
             legs["design"] = {"error": str(e)[:300]}
+    if int(os.environ.get("BENCH_FLEET", "1")):
+        try:
+            legs["serving_fleet"] = serving_fleet_leg()
+        except Exception as e:          # noqa: BLE001
+            legs["serving_fleet"] = {"error": str(e)[:300]}
     config["legs"] = legs
 
     # scale the target linearly if running fewer scenarios than the baseline
@@ -1000,6 +1005,175 @@ def serving_chaos_leg() -> dict:
         "resilience": soak["resilience"],
         "preempt": report.get("preempt"),
         "elapsed_s": round(time.time() - t0, 1),
+    }
+
+
+def serving_fleet_leg() -> dict:
+    """Fleet-serving proof (service/fleet.py + router.py): the SAME
+    mixed-structure workload served by a 1-replica and a 3-replica
+    fleet (real ``serve`` subprocesses over file spools), then a
+    failover drill — SIGKILL one replica mid-wave and measure the
+    recovery.
+
+    Published under ``legs.serving_fleet``: aggregate throughput 1 vs 3
+    replicas (timed on the warm second wave; warm-start memory disabled
+    so both passes honestly solve), structure-affinity hit rate on the
+    repeat wave, and the router's failover-latency p50/p99 for the
+    killed replica's recovered requests.
+
+    Gates: zero lost / zero failed requests everywhere (exactly-once
+    delivery through the kill), failover recovery under the request
+    deadline; on a real accelerator host (CPU replicas share physical
+    cores and cannot exhibit real scaling — ``gated_on_real_mesh``):
+    aggregate 3-replica throughput >= 2x the single replica."""
+    import shutil
+    import signal as _signal
+    import tempfile
+    from pathlib import Path
+
+    import jax
+
+    from dervet_tpu.benchlib import synthetic_sensitivity_cases
+    from dervet_tpu.service import FleetRouter, ServiceJournal, \
+        spawn_replica
+
+    platform = jax.devices()[0].platform
+    n_req = int(os.environ.get("BENCH_FLEET_REQUESTS", "12"))
+    months = int(os.environ.get("BENCH_FLEET_MONTHS", "1"))
+    lengths = (72, 96, 120, 144)
+    workdir = Path(tempfile.mkdtemp(prefix="bench-fleet-"))
+
+    def workload(tag, variant):
+        out = {}
+        for i in range(n_req):
+            case = synthetic_sensitivity_cases(
+                1, n=lengths[i % len(lengths)], months=months)[0]
+            for t, _, keys in case.ders:
+                if t == "Battery":
+                    keys["ene_max_rated"] = \
+                        8000.0 + 10.0 * i + 0.5 * variant
+            out[f"{tag}{i:02d}"] = {0: case}
+        return out
+
+    log_handles = []
+
+    def boot(root, n):
+        reps = []
+        for i in range(n):
+            logf = open(root / f"r{i}.log", "w")
+            log_handles.append(logf)
+            reps.append(spawn_replica(
+                root / f"r{i}", name=f"r{i}", backend="cpu",
+                stdout=logf, stderr=logf,
+                env={"DERVET_TPU_WARMSTART": "0"}))
+        return reps
+
+    def run_wave(router, reqs, deadline_s=600.0):
+        futs = {rid: router.submit(c, request_id=rid,
+                                   deadline_s=deadline_s)
+                for rid, c in reqs.items()}
+        return {rid: f.result(timeout=600) for rid, f in futs.items()}
+
+    def pass_(tag, n_replicas):
+        root = workdir / tag
+        root.mkdir(parents=True)
+        reps = boot(root, n_replicas)
+        router = FleetRouter(reps, fleet_dir=root / "fleet",
+                             heartbeat_timeout_s=5.0,
+                             tick_s=0.05).start()
+        try:
+            run_wave(router, workload("w1.", 0))     # pays the compiles
+            t0 = time.time()
+            run_wave(router, workload("w2.", 1))     # timed, warm
+            wall = time.time() - t0
+            m = router.metrics()
+            assert m["routing"]["failed"] == 0, m["routing"]
+            log(f"bench[serving_fleet]: {tag} — {n_req} requests in "
+                f"{wall:.2f}s ({n_req / wall:.2f} req/s), affinity hit "
+                f"rate {m['routing']['affinity_hit_rate']}")
+            return {"wall_s": wall, "router": router, "reps": reps,
+                    "metrics": m}
+        except BaseException:
+            router.close()
+            raise
+
+    single = pass_("single", 1)
+    single["router"].close()
+    fleet = pass_("fleet", 3)
+
+    # failover drill on the live 3-replica fleet: wave 3, kill one
+    # replica once it has work admitted and unfinished.  Everything
+    # from here runs under the router's finally: a drill failure must
+    # not leak three live serve subprocesses into the rest of the bench
+    router, reps = fleet["router"], fleet["reps"]
+    try:
+        futs = {rid: router.submit(c, request_id=rid, deadline_s=600.0)
+                for rid, c in workload("w3.", 2).items()}
+        victim = None
+        kill_deadline = time.time() + 120
+        while victim is None and time.time() < kill_deadline:
+            for rep in reps:
+                states = ServiceJournal.replay_path(
+                    rep.spool / "service_journal.jsonl")
+                if any(e["state"] == "admitted"
+                       for e in states.values()):
+                    victim = rep
+                    break
+            time.sleep(0.02)
+        recovered = 0
+        if victim is not None:
+            victim.process.send_signal(_signal.SIGKILL)
+        results = {rid: f.result(timeout=600) for rid, f in futs.items()}
+        recovered = sum(1 for r in results.values() if r.recovered)
+        m = router.metrics()
+    finally:
+        router.close()
+        for fh in log_handles:
+            fh.close()
+    assert len(results) == n_req and m["routing"]["failed"] == 0, \
+        "fleet drill lost or failed requests"
+
+    speedup = single["wall_s"] / fleet["wall_s"]
+    real_mesh = platform != "cpu"
+    gates = {"zero_lost": len(results) == n_req,
+             "zero_failed": m["routing"]["failed"] == 0,
+             "kill_window_hit": victim is not None}
+    if real_mesh:
+        gates["throughput_2x_vs_single_replica"] = speedup >= 2.0
+    ok = all(gates.values())
+    fol = m["failover_latency_s"]
+    log(f"bench[serving_fleet]: 3-replica {fleet['wall_s']:.2f}s vs "
+        f"single {single['wall_s']:.2f}s ({speedup:.2f}x aggregate); "
+        f"kill drill: victim {victim.name if victim else 'MISSED'}, "
+        f"{recovered} recovered, failover latency p50/p99 "
+        f"{fol['p50']}/{fol['p99']}s, "
+        f"{m['routing']['duplicates_suppressed']} duplicates "
+        f"suppressed; gates {'OK' if ok else 'FAIL'}"
+        + ("" if real_mesh else
+           " (2x gate skipped: CPU replicas share physical cores)"))
+    if not ok:
+        raise SystemExit(10)
+    shutil.rmtree(workdir, ignore_errors=True)
+    return {
+        "requests_per_wave": n_req,
+        "platform": platform,
+        "single_replica_wall_s": round(single["wall_s"], 3),
+        "fleet3_wall_s": round(fleet["wall_s"], 3),
+        "aggregate_speedup": round(speedup, 2),
+        "throughput_req_per_s": round(n_req / fleet["wall_s"], 2),
+        "affinity_hit_rate":
+            fleet["metrics"]["routing"]["affinity_hit_rate"],
+        "failover": {
+            "victim": victim.name if victim else None,
+            "recovered_requests": recovered,
+            "harvested": m["routing"]["harvested"],
+            "rerouted": m["routing"]["rerouted"],
+            "duplicates_suppressed":
+                m["routing"]["duplicates_suppressed"],
+            "latency_s": fol,
+        },
+        "gates": gates,
+        "gated_on_real_mesh": real_mesh,
     }
 
 

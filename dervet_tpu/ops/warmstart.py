@@ -241,7 +241,11 @@ class SolutionMemory:
         self._cold_iters: Dict[object, deque] = {}
         self.stats = {"stores": 0, "evictions": 0, "hits_exact": 0,
                       "hits_near": 0, "misses": 0, "substituted": 0,
-                      "stale_seed_faults": 0, "invalidated": 0}
+                      "stale_seed_faults": 0, "invalidated": 0,
+                      "imported": 0}
+        # keys imported from another replica's export (fleet failover):
+        # these serve the EXACT path only — see import_entries
+        self._imported_keys: set = set()
 
     # -- internals (caller holds the lock) ------------------------------
     def _unlink(self, key, entry) -> None:
@@ -257,6 +261,7 @@ class SolutionMemory:
         qkey = (skey, entry.quant)
         if self._by_quant.get(qkey) == key:
             del self._by_quant[qkey]
+        self._imported_keys.discard(key)
 
     def _evict_lru(self) -> None:
         while len(self._entries) > self.max_entries:
@@ -353,6 +358,62 @@ class SolutionMemory:
             self.stats["invalidated"] += len(doomed)
             return len(doomed)
 
+    # -- fleet failover handoff -----------------------------------------
+    def export_entries(self, max_entries: int = 128) -> List[Tuple]:
+        """Serializable snapshot of the most-recent entries — the
+        warm-start handoff a fleet replica publishes so that, when it
+        dies, the router can hand its converged iterates to the replica
+        that inherits its in-flight requests (the re-solve of an
+        already-solved window becomes an exact-match substitution:
+        zero device work, byte-identical bytes).
+
+        Returns ``[(key, fields), ...]`` oldest-first, where ``key`` is
+        the ``(structure key, exact digest, tolerance tag)`` LRU key and
+        ``fields`` the plain-array entry payload — picklable as long as
+        structure keys are (they are tuples of primitives)."""
+        with self._lock:
+            items = list(self._entries.items())[-int(max_entries):]
+            return [(key, {"x": np.array(e.x), "y": np.array(e.y),
+                           "obj": e.obj, "feature": np.array(e.feature),
+                           "tag": e.tag, "exact": e.exact,
+                           "quant": e.quant})
+                    for key, e in items]
+
+    def import_entries(self, payload, exact_only: bool = True) -> int:
+        """Install another replica's exported entries.  With
+        ``exact_only`` (the default, and what fleet failover uses) the
+        entries are registered in the primary store ONLY — visible to
+        byte-exact substitution (which re-verifies in float64 and ships
+        verbatim, preserving the fleet's byte-identical-failover
+        contract) but invisible to the near-seed indices: a near-grade
+        seed from foreign data would change the re-solve's iterate path
+        and so its low-order result bits.  Entries already present (or
+        malformed) are skipped.  Returns the number installed."""
+        n = 0
+        for key, f in payload:
+            try:
+                entry = SeedEntry(
+                    x=np.asarray(f["x"]), y=np.asarray(f["y"]),
+                    obj=float(f["obj"]), feature=np.asarray(f["feature"]),
+                    tag=tuple(f["tag"]), exact=bytes(f["exact"]),
+                    quant=bytes(f["quant"]))
+                key = (key[0], bytes(key[1]), tuple(key[2]))
+            except (KeyError, TypeError, ValueError, IndexError):
+                continue
+            with self._lock:
+                if key in self._entries:
+                    continue
+                self._entries[key] = entry
+                if exact_only:
+                    self._imported_keys.add(key)
+                else:
+                    self._by_struct.setdefault(key[0], {})[key] = entry
+                    self._by_quant[(key[0], entry.quant)] = key
+                self.stats["imported"] += 1
+                n += 1
+                self._evict_lru()
+        return n
+
     def note_cold_iters(self, skey, iters) -> None:
         """Record cold members' iteration counts — the per-structure
         baseline ``iters_saved`` is measured against."""
@@ -369,6 +430,7 @@ class SolutionMemory:
         with self._lock:
             return {"entries": len(self._entries),
                     "structures": len(self._by_struct),
+                    "imported_live": len(self._imported_keys),
                     "max_entries": self.max_entries,
                     "bytes": int(sum(e.x.nbytes + e.y.nbytes
                                      for e in self._entries.values())),

@@ -149,7 +149,15 @@ class CaseResult:
         self.sizing_df = s.poi.sizing_summary()
         self.monthly_data = s.service_agg.monthly_report()
         if s.objective_values:
-            self.objective_values = pd.DataFrame(s.objective_values).T
+            # canonical window order, not round-insertion order: a
+            # window dict entry lands when its structure GROUP finishes,
+            # and a case whose remainder window rides a different-width
+            # group than its main windows sees that order shift with
+            # round composition (what else the serving layer co-batched
+            # this round) — sorting keeps the CSV surface byte-stable
+            # across single-run, coalesced, and fleet-failover serving
+            self.objective_values = pd.DataFrame(
+                s.objective_values).T.sort_index(kind="stable")
         self.drill_down_dict.update(
             s.service_agg.drill_down_dfs(self.time_series_data, s.dt))
         rel = s.streams.get("Reliability")

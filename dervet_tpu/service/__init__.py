@@ -2,20 +2,31 @@
 continuous batching (see server.py for the architecture notes), the
 self-healing resilience layer (see resilience.py: circuit breakers,
 load shedding with degraded-fidelity answers, backend-loss recovery,
-poison-request quarantine, crash-safe serve journal), and the BOOST
+poison-request quarantine, crash-safe serve journal), the BOOST
 design request type (``submit_design`` — ordinal screening + certified
 frontier; engine in ``dervet_tpu.design``, integration in
-``design.service``)."""
+``design.service``), and the multi-replica fleet tier (``fleet.py`` /
+``router.py``: N serve-loop replicas behind a ``FleetRouter`` with
+structure-affinity routing, health-probed failover, and exactly-once
+recovery of a dead replica's in-flight requests)."""
+from ..utils.errors import FleetUnavailableError, ReplicaAnswerError
 from .client import ScenarioClient
+from .fleet import (LocalReplica, ReplicaHandle, SpoolReplica,
+                    spawn_replica, structure_fingerprint)
 from .journal import ServiceJournal
 from .queue import (AdmissionQueue, BreakerOpenError, DeadlineExpiredError,
                     PoisonRequestError, QueueFullError, RequestFailedError,
                     RequestPreemptedError, ServiceClosedError, ServiceError)
+from .router import FleetRouter, RoutedResult
 from .server import ScenarioService, serve_main
 
 __all__ = [
     "AdmissionQueue", "BreakerOpenError", "DeadlineExpiredError",
-    "PoisonRequestError", "QueueFullError", "RequestFailedError",
-    "RequestPreemptedError", "ScenarioClient", "ScenarioService",
-    "ServiceClosedError", "ServiceError", "ServiceJournal", "serve_main",
+    "FleetRouter", "FleetUnavailableError", "LocalReplica",
+    "PoisonRequestError", "QueueFullError", "ReplicaAnswerError",
+    "ReplicaHandle", "RequestFailedError", "RequestPreemptedError",
+    "RoutedResult", "ScenarioClient", "ScenarioService",
+    "ServiceClosedError", "ServiceError", "ServiceJournal",
+    "SpoolReplica", "serve_main", "spawn_replica",
+    "structure_fingerprint",
 ]

@@ -18,17 +18,24 @@ from .queue import QueueFullError
 
 
 class ScenarioClient:
-    """Thin client over a :class:`ScenarioService`.
+    """Thin client over a :class:`ScenarioService` — or a
+    :class:`~dervet_tpu.service.router.FleetRouter`, which exposes the
+    same ``submit`` surface.
 
-    ``submit`` honors the service's backpressure contract: a
+    ``submit`` honors the backpressure contract end-to-end: a
     :class:`~dervet_tpu.service.queue.QueueFullError` carries a
     ``retry_after_s`` hint (derived from the service's observed drain
     rate), and the client sleeps it out — CAPPED and JITTERED — and
     retries up to ``max_retries`` times before surfacing the rejection.
-    The jitter (±25% around the hint) matters at fleet scale: a burst
-    of rejected clients all honoring the same hint verbatim would
-    re-arrive in one synchronized spike and re-overload the server they
-    just backed off from."""
+    Router redirects preserve the discipline: when every replica behind
+    a fleet router rejects, the router raises
+    :class:`~dervet_tpu.utils.errors.FleetUnavailableError` — a
+    ``QueueFullError`` whose ``retry_after_s`` is the SMALLEST hint any
+    replica offered — so the per-replica drain-rate hint survives the
+    routing hop and the same capped ±25% backoff applies unchanged.
+    The jitter matters at fleet scale: a burst of rejected clients all
+    honoring the same hint verbatim would re-arrive in one synchronized
+    spike and re-overload the fleet they just backed off from."""
 
     def __init__(self, service, max_retries: int = 3,
                  backoff_cap_s: float = 30.0, jitter_frac: float = 0.25,

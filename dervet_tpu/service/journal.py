@@ -34,6 +34,10 @@ from typing import Dict, List, Optional, Tuple
 from ..utils.errors import TellUser
 
 TERMINAL_EVENTS = ("completed", "failed")
+# a hedge loser retracted before admission (fleet router cancel): not a
+# terminal answer, but recovery must finish its input-file removal, not
+# re-serve it
+CANCELLED_EVENT = "cancelled"
 
 
 class ServiceJournal:
@@ -67,21 +71,28 @@ class ServiceJournal:
     def failed(self, rid: str, error: Optional[Dict] = None) -> None:
         self._append("failed", rid, **({"error": error} if error else {}))
 
+    def note(self, event: str, rid: str, **extra) -> None:
+        """Journal an arbitrary event (fsync'd like the rest).  The
+        fleet layer uses this for its routing ledger (``routed`` /
+        ``rerouted`` / ``hedged`` / ``cancelled``) on top of the three
+        spool events above."""
+        self._append(str(event), rid, **extra)
+
     def close(self) -> None:
         with self._lock:
             self._fh.close()
 
     # ------------------------------------------------------------------
-    def replay(self) -> Dict[str, Dict]:
-        """Reconstruct each request id's LAST journaled state:
-        ``rid -> {"state": admitted|completed|failed, "file": ...}``.
-        A torn final line (crash mid-append) is skipped, not fatal."""
+    @staticmethod
+    def replay_path(path) -> Dict[str, Dict]:
+        """Read-only replay of a journal file that may belong to ANOTHER
+        process (the fleet router inspecting a dead replica's spool) —
+        no append handle is opened, so this never touches the file."""
         out: Dict[str, Dict] = {}
-        if not self.path.exists():
+        path = Path(path)
+        if not path.exists():
             return out
-        with self._lock:
-            self._fh.flush()
-        for line in self.path.read_text(encoding="utf-8").splitlines():
+        for line in path.read_text(encoding="utf-8").splitlines():
             line = line.strip()
             if not line:
                 continue
@@ -95,6 +106,14 @@ class ServiceJournal:
             if rec.get("file"):
                 entry["file"] = rec["file"]
         return out
+
+    def replay(self) -> Dict[str, Dict]:
+        """Reconstruct each request id's LAST journaled state:
+        ``rid -> {"state": admitted|completed|failed, "file": ...}``.
+        A torn final line (crash mid-append) is skipped, not fatal."""
+        with self._lock:
+            self._fh.flush()
+        return self.replay_path(self.path)
 
     def unfinished(self) -> List[Tuple[str, Optional[str]]]:
         """Request ids admitted but never terminal — the set a restarted
@@ -123,11 +142,27 @@ class ServiceJournal:
                 continue
             if entry["state"] == "admitted":
                 reserve.append(rid)
+            elif entry["state"] == CANCELLED_EVENT:
+                # kill landed between journaling the cancel and removing
+                # the input: finish the removal, never re-serve a
+                # retracted hedge loser
+                try:
+                    src.unlink()
+                except FileNotFoundError:
+                    pass
             elif entry["state"] in TERMINAL_EVENTS:
                 # a journaled FAILURE must not be misfiled as a success
                 target = (failed_dir if entry["state"] == "failed"
                           and failed_dir is not None else done_dir)
-                src.replace(target / src.name)
+                try:
+                    src.replace(target / src.name)
+                except FileNotFoundError:
+                    # a CONCURRENT recovery (router failover firing while
+                    # the replica restarts) won the move between our
+                    # exists() check and the replace — the outcome is the
+                    # same file in the same terminal directory, so the
+                    # race is benign; claiming the move twice is not
+                    continue
                 moved.append(rid)
         if reserve or moved:
             TellUser.warning(

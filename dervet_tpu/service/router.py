@@ -1,0 +1,837 @@
+"""FleetRouter: multi-replica serving with exactly-once failover.
+
+The router fronts N :class:`~dervet_tpu.service.fleet.ReplicaHandle`
+replicas (separate ``dervet-tpu serve`` processes over file spools, or
+in-process services) and owns three jobs:
+
+* **Routing** — requests go to the replica whose compiled-solver cache
+  and warm-start memory are already hot for their shape:
+  :func:`~dervet_tpu.service.fleet.structure_fingerprint` keys a sticky
+  affinity map, falling back to the least-loaded healthy replica.  A
+  replica whose circuit breaker (``utils/breaker.py``) is open is
+  skipped; queue-full rejections redirect to the next replica, and when
+  EVERY replica rejects, the typed
+  :class:`~dervet_tpu.utils.errors.FleetUnavailableError` carries the
+  smallest per-replica ``retry_after_s`` drain-rate hint through the
+  routing hop — the hint is never dropped at the redirect.
+* **Health** — every monitor tick reads each replica's heartbeat; a
+  replica that misses heartbeats past ``heartbeat_timeout_s`` (or whose
+  process exited) is declared dead: its breaker force-trips, admissions
+  re-route, and its in-flight requests recover.  A *flapping* replica —
+  alive but failing its requests — trips the same breaker through the
+  sliding window; after the cooldown the router probes it with a
+  heartbeat nonce (no solve) and either closes the breaker or re-opens
+  it.
+* **Exactly-once failover** — a dead replica's requests are reconciled
+  against its own crash-safe journal + results artifacts: answers it
+  journaled as completed before dying are HARVESTED (results were
+  persisted before the journal record, so they exist — no re-solve);
+  everything else is retracted from its spool (fencing: the process is
+  SIGKILLed first so it cannot wake up and keep writing) and re-routed
+  to a healthy replica, together with the dead replica's last
+  warm-start memory export so already-converged windows re-solve as
+  exact-match substitutions (zero device work, byte-identical bytes).
+  Delivery is first-answer-wins: a late answer from a hung-but-revived
+  replica or a hedge loser is counted (``duplicates_suppressed``) and
+  discarded, so each request is answered exactly once — and, because
+  dispatch is deterministic and imported memory serves the exact-match
+  grade only, byte-identical to a single-replica run.
+
+**Hedging** — a deadline-pressured request that has waited
+``hedge_wait_frac`` of its deadline without an answer is mirrored once
+onto a second replica; the first answer wins and the loser is cancelled
+at a round boundary (retracted if not yet admitted, discarded if it
+answers anyway).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ..utils.breaker import BreakerBoard
+from ..utils.errors import (FleetUnavailableError, QueueFullError,
+                            ReplicaAnswerError, ServiceClosedError,
+                            TellUser)
+from .fleet import ReplicaHandle, SpoolReplica, structure_fingerprint
+from .journal import ServiceJournal
+from .server import _REQUEST_ID_RE
+
+
+@dataclasses.dataclass
+class RoutedResult:
+    """One delivered fleet answer.  ``result`` is the in-process
+    :class:`~dervet_tpu.results.result.Result` for local-transport
+    replicas; spool-transport answers are artifact references
+    (``results_dir`` — the replica's ``results/<rid>/`` output set,
+    run-health slice included)."""
+
+    rid: str
+    replica: str
+    result: Optional[object] = None
+    results_dir: Optional[Path] = None
+    latency_s: Optional[float] = None
+    recovered: bool = False      # answered by a failover re-route
+    harvested: bool = False      # recovered from a dead replica's spool
+    hedged: bool = False         # answered by the hedge route
+
+    def load_run_health(self) -> Optional[Dict]:
+        """The request's run-health slice (spool transport reads the
+        ``run_health.<rid>.json`` artifact)."""
+        if self.result is not None:
+            return getattr(self.result, "run_health", None)
+        if self.results_dir is None:
+            return None
+        path = self.results_dir / f"run_health.{self.rid}.json"
+        if not path.exists():
+            path = self.results_dir / "run_health.json"
+        try:
+            return json.loads(path.read_text())
+        except (OSError, ValueError):
+            return None
+
+
+class _Route:
+    __slots__ = ("replica", "t", "kind", "resolved")
+
+    def __init__(self, replica: str, kind: str):
+        self.replica = replica
+        self.t = time.monotonic()
+        self.kind = kind            # "primary" | "hedge" | "failover"
+        self.resolved = False
+
+
+class _Pending:
+    __slots__ = ("rid", "fp", "cases", "payload", "priority",
+                 "deadline_epoch", "deadline_s", "future", "routes",
+                 "t_submit", "answered", "answered_at", "recovered",
+                 "unplaced_since")
+
+    def __init__(self, rid, fp, cases, priority, deadline_s):
+        self.rid = rid
+        self.fp = fp
+        self.cases = cases
+        self.payload: Optional[bytes] = None
+        self.priority = int(priority)
+        self.deadline_s = deadline_s
+        self.deadline_epoch = (None if deadline_s is None
+                               else time.time() + float(deadline_s))
+        self.future: Future = Future()
+        self.routes: List[_Route] = []
+        self.t_submit = time.monotonic()
+        self.answered = False
+        self.answered_at: Optional[float] = None
+        self.recovered = False
+        self.unplaced_since: Optional[float] = None
+
+    def live_routes(self) -> List[_Route]:
+        return [r for r in self.routes if not r.resolved]
+
+
+class FleetRouter:
+    """Router over N replicas — see the module docstring for the model.
+
+    Thread model: ``submit`` routes inline under the router lock; one
+    daemon monitor thread polls answers, watches health, fails over,
+    and hedges.  All ``metrics()`` counters are lock-protected."""
+
+    def __init__(self, replicas, *, fleet_dir=None,
+                 heartbeat_timeout_s: float = 3.0,
+                 startup_grace_s: float = 120.0,
+                 tick_s: float = 0.05,
+                 request_timeout_s: Optional[float] = None,
+                 hedging: bool = True,
+                 hedge_wait_frac: float = 0.5,
+                 hedge_min_wait_s: float = 0.5,
+                 max_inflight_per_replica: int = 32,
+                 placement_patience_s: float = 60.0,
+                 probe_timeout_s: Optional[float] = None,
+                 breaker_opts: Optional[Dict] = None,
+                 affinity_cap: int = 4096):
+        handles = (replicas.values() if isinstance(replicas, dict)
+                   else replicas)
+        self.replicas: Dict[str, ReplicaHandle] = {
+            h.name: h for h in handles}
+        if len(self.replicas) < len(list(handles)):
+            raise ValueError("replica names must be unique")
+        self.fleet_dir = Path(fleet_dir) if fleet_dir else None
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.startup_grace_s = float(startup_grace_s)
+        self.tick_s = float(tick_s)
+        self.request_timeout_s = request_timeout_s
+        self.hedging = bool(hedging)
+        self.hedge_wait_frac = float(hedge_wait_frac)
+        self.hedge_min_wait_s = float(hedge_min_wait_s)
+        self.max_inflight_per_replica = int(max_inflight_per_replica)
+        self.placement_patience_s = float(placement_patience_s)
+        self.probe_timeout_s = (float(probe_timeout_s)
+                                if probe_timeout_s is not None
+                                else 2.0 * self.heartbeat_timeout_s)
+        # per-replica breakers: small window + short cooldown — replica
+        # failure evidence is request-level and the probe is cheap
+        self.breakers = BreakerBoard(**{
+            "window": 8, "min_samples": 2, "failure_threshold": 0.5,
+            "cooldown_s": 5.0, **(breaker_opts or {})})
+        self.journal: Optional[ServiceJournal] = None
+        if self.fleet_dir is not None:
+            self.fleet_dir.mkdir(parents=True, exist_ok=True)
+            self.journal = ServiceJournal(
+                self.fleet_dir / "fleet_journal.jsonl")
+        self._lock = threading.RLock()
+        self._pending: Dict[str, _Pending] = {}
+        # retired rids (answered) — bounded memo so a rid can neither be
+        # re-used against stale spool artifacts nor double-delivered
+        self._retired: "OrderedDict[str, str]" = OrderedDict()
+        self._retired_cap = 65536
+        self._affinity: "OrderedDict[str, str]" = OrderedDict()
+        self._affinity_cap = int(affinity_cap)
+        self._inflight: Dict[str, int] = {n: 0 for n in self.replicas}
+        # per-replica completion timestamps: the drain-rate estimator
+        # behind this router's own retry-after hints (spool transport
+        # has no synchronous queue-full signal to borrow)
+        self._completions: Dict[str, deque] = {
+            n: deque(maxlen=32) for n in self.replicas}
+        # monotonic time a FRESH beat (age within the timeout) was first
+        # seen per replica: staleness can only kill a replica the router
+        # has actually seen alive — a stale heartbeat.json left in a
+        # REUSED spool must not get a booting replica fenced before its
+        # first beat (startup grace covers that window instead)
+        self._first_seen: Dict[str, Optional[float]] = {
+            n: None for n in self.replicas}
+        # monotonic time a non-None heartbeat was last READ, so a
+        # heartbeat that vanishes (local replica killed, spool wiped) is
+        # detected just like one whose timestamp goes stale
+        self._last_beat: Dict[str, Optional[float]] = {
+            n: None for n in self.replicas}
+        # monitor-cached heartbeat per replica: the submit path's
+        # _eligible() reads this instead of re-parsing heartbeat.json
+        # from disk under the router lock on every submit
+        self._hb_cache: Dict[str, Optional[Dict]] = {
+            n: None for n in self.replicas}
+        self._probes: Dict[str, Dict] = {}
+        self._memory_handoffs: Dict[str, int] = {}
+        self._seq = 0
+        self._t_start = time.monotonic()
+        self._counters = {
+            "submitted": 0, "completed": 0, "failed": 0,
+            "affinity_hits": 0, "affinity_misses": 0, "redirects": 0,
+            "rejected_unavailable": 0, "failovers": 0, "harvested": 0,
+            "rerouted": 0, "watchdog_reroutes": 0, "hedged": 0,
+            "hedge_wins": 0, "duplicates_suppressed": 0,
+            "heartbeat_deaths": 0, "probes_sent": 0, "probes_ok": 0,
+            "memory_handoffs": 0, "cancels_sent": 0,
+        }
+        self._latencies = deque(maxlen=4096)
+        self._failover_latencies: List[float] = []
+        self._closed = False
+        self._monitor: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "FleetRouter":
+        if self._monitor is None:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, daemon=True,
+                name="dervet-fleet-monitor")
+            self._monitor.start()
+        return self
+
+    def close(self, terminate_replicas: bool = True) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self._monitor is not None:
+            self._monitor.join(timeout=10)
+            self._monitor = None
+        with self._lock:
+            for p in list(self._pending.values()):
+                if not p.answered and not p.future.done():
+                    p.future.set_exception(ServiceClosedError(
+                        f"request {p.rid!r} unanswered at fleet router "
+                        "close — resubmit to a live fleet"))
+            self._pending.clear()
+        if terminate_replicas:
+            for h in self.replicas.values():
+                if isinstance(h, SpoolReplica) and h.process is not None:
+                    h.terminate()
+        if self.fleet_dir is not None:
+            from ..utils.supervisor import atomic_write
+            atomic_write(self.fleet_dir / "fleet_metrics.json",
+                         json.dumps(self.metrics(), indent=2))
+        if self.journal is not None:
+            self.journal.close()
+
+    def __enter__(self) -> "FleetRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- admission / routing --------------------------------------------
+    def submit(self, cases, *, request_id=None, priority: int = 0,
+               deadline_s: Optional[float] = None) -> Future:
+        """Route one request; returns the future its
+        :class:`RoutedResult` (or typed error) is delivered through.
+        Raises :class:`FleetUnavailableError` (a ``QueueFullError``,
+        ``retry_after_s`` = the smallest hint any replica offered) when
+        no replica can take it right now."""
+        with self._lock:
+            if self._closed:
+                raise ServiceClosedError(
+                    "fleet router is closed — no new admissions")
+            if request_id is None:
+                self._seq += 1
+                request_id = f"f{self._seq:06d}"
+            rid = str(request_id)
+            if not _REQUEST_ID_RE.match(rid):
+                raise ValueError(
+                    f"request id {rid!r} must match [A-Za-z0-9._-]{{1,64}}"
+                    " — it names spool payloads and result artifacts")
+            if rid in self._pending or rid in self._retired:
+                raise ValueError(
+                    f"request id {rid!r} was already routed through this "
+                    "fleet — ids are once-only (they key the replicas' "
+                    "duplicate-suppression journals)")
+            if not isinstance(cases, dict):
+                cases = dict(enumerate(cases))
+            if not cases:
+                raise ValueError("a request needs at least one case")
+            p = _Pending(rid, structure_fingerprint(cases), cases,
+                         priority, deadline_s)
+            self._route(p, kind="primary")   # raises if nowhere to go
+            self._pending[rid] = p
+            self._counters["submitted"] += 1
+        if self.journal is not None:
+            self.journal.note("routed", rid,
+                              replica=p.routes[-1].replica)
+        return p.future
+
+    def _retry_hint(self, name: str) -> float:
+        """Seconds a rejected caller should wait for ``name`` to drain:
+        its current inflight divided by its observed completion rate.
+        Caller holds the lock."""
+        comp = self._completions[name]
+        if len(comp) >= 2 and comp[-1] > comp[0]:
+            rate = (len(comp) - 1) / (comp[-1] - comp[0])
+            hint = (self._inflight[name] + 1) / max(rate, 1e-6)
+            return float(min(600.0, max(0.05, hint)))
+        return 1.0
+
+    def _eligible(self, exclude=()) -> List[str]:
+        """Routable replica names: up, not draining, breaker not open.
+        Caller holds the lock."""
+        out = []
+        for name, h in self.replicas.items():
+            if name in exclude or h.state == "dead":
+                continue
+            if self.breakers.is_open(name):
+                continue
+            # monitor-cached beat: good enough for the draining flag,
+            # and keeps disk I/O out of the locked submit path
+            hb = self._hb_cache.get(name)
+            if hb is not None and hb.get("draining"):
+                continue
+            out.append(name)
+        return out
+
+    def _route(self, p: _Pending, kind: str, exclude=()) -> Optional[str]:
+        """Pick a replica for ``p`` and hand the request over.  Caller
+        holds the lock.  Local-transport queue-full rejections redirect
+        down the candidate list; if every candidate rejects, the typed
+        error carries the smallest retry hint (primary routes raise it
+        to the submitter; failover/hedge routes return None and the
+        monitor retries placement)."""
+        eligible = self._eligible(exclude=exclude)
+        # affinity first: the replica already warm for this structure
+        ordered: List[str] = []
+        aff = self._affinity.get(p.fp)
+        aff_available = (aff in eligible
+                         and self._inflight[aff]
+                         < self.max_inflight_per_replica)
+        if aff_available:
+            ordered.append(aff)
+        # then least-loaded (stable tie-break on name)
+        ordered += sorted(
+            (n for n in eligible
+             if n not in ordered
+             and self._inflight[n] < self.max_inflight_per_replica),
+            key=lambda n: (self._inflight[n], n))
+        hints = []
+        for i, name in enumerate(ordered):
+            h = self.replicas[name]
+            try:
+                h.submit(p.cases, p.rid, priority=p.priority,
+                         deadline_epoch=p.deadline_epoch,
+                         payload=self._payload_for(p, h))
+            except QueueFullError as e:
+                # the replica's own drain-rate hint: keep it, try the
+                # next replica (the router redirect), surface the MIN
+                hints.append(float(e.retry_after_s))
+                self._counters["redirects"] += 1
+                continue
+            if kind == "primary":
+                if aff_available and name == aff:
+                    self._counters["affinity_hits"] += 1
+                else:
+                    self._counters["affinity_misses"] += 1
+            self._affinity[p.fp] = name
+            self._affinity.move_to_end(p.fp)
+            while len(self._affinity) > self._affinity_cap:
+                self._affinity.popitem(last=False)
+            p.routes.append(_Route(name, kind))
+            p.unplaced_since = None
+            self._inflight[name] += 1
+            return name
+        # nowhere to go
+        if not hints and not ordered and eligible:
+            # every healthy replica is at its inflight bound: this
+            # router-side backpressure gets the same drain-rate hint a
+            # replica queue would compute
+            hints = [self._retry_hint(n) for n in eligible]
+        if not hints:
+            hint = min((self.breakers.get(n).probe_in_s() or 1.0
+                        for n in self.replicas
+                        if self.replicas[n].state != "dead"),
+                       default=1.0)
+            msg = ("no healthy fleet replica available (dead/draining/"
+                   "breaker-open)")
+        else:
+            hint = min(hints)
+            msg = (f"all {len(hints)} routable replica(s) rejected the "
+                   "request (queue full / inflight bound)")
+        if kind == "primary":
+            self._counters["rejected_unavailable"] += 1
+            raise FleetUnavailableError(
+                f"request {p.rid!r} not routed: {msg}; retry after "
+                f"{hint:.2f}s", retry_after_s=hint)
+        if p.unplaced_since is None:
+            p.unplaced_since = time.monotonic()
+        return None
+
+    def _payload_for(self, p: _Pending, h: ReplicaHandle
+                     ) -> Optional[bytes]:
+        """Pickle a spool payload once and reuse it for every re-route /
+        hedge of the same request (local transport needs none)."""
+        if not isinstance(h, SpoolReplica):
+            return None
+        if p.payload is None:
+            p.payload = SpoolReplica.encode_payload(
+                p.cases, priority=p.priority,
+                deadline_epoch=p.deadline_epoch)
+        return p.payload
+
+    # -- the monitor ----------------------------------------------------
+    def _monitor_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+            try:
+                self._tick()
+            except Exception as e:      # the monitor must never die
+                TellUser.error(f"fleet: monitor tick errored: {e}")
+            time.sleep(self.tick_s)
+
+    def _tick(self) -> None:
+        self._poll_answers()
+        self._check_health()
+        self._watchdogs()
+        # answered entries linger only to count late duplicates from
+        # hedge/failover losers; prune them after a bounded window so a
+        # loser that never answers cannot pin memory
+        now = time.monotonic()
+        with self._lock:
+            for rid in [p.rid for p in self._pending.values()
+                        if p.answered and p.answered_at is not None
+                        and now - p.answered_at > 60.0]:
+                self._pending.pop(rid, None)
+
+    def _poll_answers(self) -> None:
+        with self._lock:
+            items = [(p, r) for p in self._pending.values()
+                     for r in p.live_routes()]
+        for p, route in items:
+            h = self.replicas[route.replica]
+            try:
+                outcome = h.poll(p.rid)
+            except Exception:
+                continue
+            if outcome is None:
+                continue
+            self._deliver(p, route, outcome)
+
+    def _deliver(self, p: _Pending, route: _Route, outcome,
+                 harvested: bool = False) -> None:
+        kind, answer = outcome
+        with self._lock:
+            if route.resolved:
+                return
+            route.resolved = True
+            self._inflight[route.replica] = max(
+                0, self._inflight[route.replica] - 1)
+            first = not p.answered
+            if first:
+                p.answered = True
+                p.answered_at = time.monotonic()
+                self._retire(p.rid, route.replica)
+            else:
+                self._counters["duplicates_suppressed"] += 1
+            self._gc_pending(p)
+            if not first:
+                return
+            latency = time.monotonic() - p.t_submit
+            self._latencies.append(latency)
+            self._completions[route.replica].append(time.monotonic())
+            if route.kind == "hedge":
+                self._counters["hedge_wins"] += 1
+            if route.kind == "failover" or harvested:
+                self._failover_latencies.append(latency)
+            losers = p.live_routes()
+        # answering at all is evidence the replica works — typed request
+        # failures (bad inputs) are the request's fault, not the path's
+        self.breakers.record(route.replica, True)
+        # hedge/failover losers: cancel at the next round boundary; a
+        # result that lands anyway is suppressed above
+        for loser in losers:
+            try:
+                self.replicas[loser.replica].cancel(p.rid)
+                self._counters["cancels_sent"] += 1
+            except Exception:
+                pass
+        if kind == "done":
+            res = RoutedResult(
+                rid=p.rid, replica=route.replica,
+                result=None if isinstance(answer, Path) else answer,
+                results_dir=answer if isinstance(answer, Path) else None,
+                latency_s=latency,
+                recovered=(route.kind == "failover" or harvested),
+                harvested=harvested,
+                hedged=(route.kind == "hedge"))
+            with self._lock:
+                self._counters["completed"] += 1
+            if self.journal is not None:
+                self.journal.completed(p.rid)
+            p.future.set_result(res)
+        else:
+            err = (answer if isinstance(answer, BaseException)
+                   else ReplicaAnswerError(
+                       f"request {p.rid!r} failed on replica "
+                       f"{route.replica!r}: "
+                       f"{(answer or {}).get('message', 'unknown')}",
+                       payload=answer, replica=route.replica))
+            with self._lock:
+                self._counters["failed"] += 1
+            if self.journal is not None:
+                self.journal.failed(p.rid, getattr(err, "payload", None)
+                                    or {"message": str(err)})
+            p.future.set_exception(err)
+
+    def _retire(self, rid: str, replica: str) -> None:
+        """Caller holds the lock."""
+        self._retired[rid] = replica
+        while len(self._retired) > self._retired_cap:
+            self._retired.popitem(last=False)
+
+    def _gc_pending(self, p: _Pending) -> None:
+        """Drop an answered entry once no live route could still answer
+        (so late duplicates in flight are still counted).  Caller holds
+        the lock."""
+        if p.answered and not any(
+                not r.resolved
+                and self.replicas[r.replica].state != "dead"
+                for r in p.routes):
+            self._pending.pop(p.rid, None)
+
+    # -- health / failover ----------------------------------------------
+    def _check_health(self) -> None:
+        now = time.time()
+        for name, h in self.replicas.items():
+            hb = h.heartbeat()
+            self._hb_cache[name] = hb
+            fresh = (hb is not None
+                     and now - float(hb.get("t", 0))
+                     <= self.heartbeat_timeout_s)
+            if hb is not None:
+                self._last_beat[name] = time.monotonic()
+            if fresh and self._first_seen[name] is None:
+                self._first_seen[name] = time.monotonic()
+            if h.state == "dead":
+                # a restarted replica announces itself with FRESH
+                # heartbeats: resurrect the routing state (the breaker's
+                # probe cycle still gates traffic).  For a router-owned
+                # process that died, a fresh beat can only come from a
+                # NEW process over the same spool — its pid differs, and
+                # the handle stops owning (fencing a process we did not
+                # spawn would be wrong)
+                new_pid = (hb is not None
+                           and getattr(h, "process", None) is not None
+                           and hb.get("pid") not in
+                           (None, h.process.pid))
+                if fresh and (h.alive() is not False or new_pid):
+                    if new_pid:
+                        h.process = None
+                    h.state = "up"
+                    TellUser.warning(
+                        f"fleet: replica {name!r} is heartbeating again "
+                        "— resurrected (breaker still gates routing)")
+                else:
+                    continue
+            dead_reason = None
+            if h.alive() is False:
+                dead_reason = "process exited"
+            elif self._first_seen[name] is None:
+                # never seen a fresh beat: a stale heartbeat.json in a
+                # REUSED spool must not fence a still-booting replica —
+                # only the startup grace can expire it
+                if time.monotonic() - self._t_start \
+                        > self.startup_grace_s:
+                    dead_reason = ("no fresh heartbeat within the "
+                                   f"{self.startup_grace_s:g}s startup "
+                                   "grace")
+            elif hb is None:
+                last = self._last_beat[name]
+                if last is not None and \
+                        time.monotonic() - last > self.heartbeat_timeout_s:
+                    dead_reason = "heartbeat disappeared"
+            elif not fresh:
+                age = now - float(hb.get("t", 0))
+                dead_reason = (f"heartbeats stopped "
+                               f"({age:.1f}s > "
+                               f"{self.heartbeat_timeout_s:g}s)")
+            if dead_reason is not None:
+                self._declare_dead(name, dead_reason)
+            else:
+                self._probe_cycle(name, hb)
+
+    def _probe_cycle(self, name: str, hb: Optional[Dict]) -> None:
+        """Half-open probing for a breaker-opened (flapping) replica:
+        send a heartbeat nonce, close the breaker when it echoes."""
+        br = self.breakers.get(name)
+        pr = self._probes.get(name)
+        if pr is not None:
+            if hb is not None and \
+                    str(hb.get("probe_nonce")) == pr["nonce"]:
+                self._probes.pop(name, None)
+                with self._lock:
+                    self._counters["probes_ok"] += 1
+                # counter first: record(True) closes the breaker, which
+                # is what callers wait on — the count must already be
+                # there when they look
+                br.record(True)
+                return
+            if time.monotonic() - pr["t"] > self.probe_timeout_s:
+                self._probes.pop(name, None)
+                br.record(False)
+            return
+        if br.state != br.CLOSED and br.allow():
+            nonce = f"{name}-{time.time_ns()}"
+            try:
+                self.replicas[name].probe(nonce)
+            except Exception:
+                br.record(False)
+                return
+            self._probes[name] = {"nonce": nonce, "t": time.monotonic()}
+            with self._lock:
+                self._counters["probes_sent"] += 1
+
+    def _declare_dead(self, name: str, reason: str) -> None:
+        h = self.replicas[name]
+        h.state = "dead"
+        with self._lock:
+            self._counters["heartbeat_deaths"] += 1
+        TellUser.error(f"fleet: replica {name!r} declared DEAD "
+                       f"({reason}) — failing over its in-flight "
+                       "requests")
+        self.breakers.trip(name, reason)
+        if self.journal is not None:
+            self.journal.note("replica_dead", name, reason=reason)
+        self._failover(name)
+
+    def _failover(self, name: str) -> None:
+        h = self.replicas[name]
+        h.kill()                        # fence before re-routing
+        with self._lock:
+            self._counters["failovers"] += 1
+            victims = [(p, r) for p in self._pending.values()
+                       for r in p.live_routes() if r.replica == name]
+        blob = h.read_memory_export()
+        handed_off: set = set()
+        for p, route in victims:
+            state = h.request_state(p.rid)
+            if state in ("completed", "failed"):
+                # the replica finished this one before dying: harvest —
+                # results were persisted BEFORE its journal's terminal
+                # record, so the answer exists on disk; no re-solve,
+                # no double answer
+                outcome = h.poll(p.rid)
+                if outcome is None and state == "completed":
+                    outcome = ("done", getattr(h, "results_root",
+                                               Path(".")) / p.rid)
+                if outcome is not None:
+                    # only a FIRST delivery is a genuine recovery; an
+                    # already-answered request (hedge winner landed
+                    # earlier) is just a suppressed duplicate and must
+                    # not inflate the harvested metric the smoke/bench
+                    # gates read.  No race: delivery happens only on
+                    # this monitor thread.
+                    if not p.answered:
+                        with self._lock:
+                            self._counters["harvested"] += 1
+                        if self.journal is not None:
+                            self.journal.note("harvested", p.rid,
+                                              replica=name)
+                    self._deliver(p, route, outcome, harvested=True)
+                    continue
+            # unanswered: fence its spool entry, then re-route with the
+            # dead replica's warm-start memory riding along
+            with self._lock:
+                route.resolved = True
+                self._inflight[name] = max(0, self._inflight[name] - 1)
+                if p.answered:
+                    self._gc_pending(p)
+                    continue
+            try:
+                h.retract(p.rid)
+            except Exception:
+                pass
+            target = self._reroute(p, exclude={name},
+                                   counter="rerouted")
+            if blob and target is not None and target not in handed_off:
+                try:
+                    self.replicas[target].import_memory(blob)
+                    handed_off.add(target)
+                    with self._lock:
+                        self._counters["memory_handoffs"] += 1
+                        self._memory_handoffs[target] = \
+                            self._memory_handoffs.get(target, 0) + 1
+                except Exception as e:
+                    TellUser.warning(
+                        f"fleet: warm-start handoff to {target!r} "
+                        f"failed: {e}")
+
+    def _reroute(self, p: _Pending, exclude, counter: str
+                 ) -> Optional[str]:
+        with self._lock:
+            if p.answered:
+                return None
+            p.recovered = True
+            target = self._route(p, kind="failover", exclude=exclude)
+            if target is not None:
+                self._counters[counter] += 1
+        if target is not None and self.journal is not None:
+            self.journal.note("rerouted", p.rid, to=target)
+        return target
+
+    # -- watchdog + hedging ---------------------------------------------
+    def _watchdogs(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            entries = [p for p in self._pending.values()
+                       if not p.answered]
+        for p in entries:
+            live = p.live_routes()
+            if not live:
+                # unplaced (failover found no healthy target): retry
+                # placement; give up loudly after the patience window
+                # or the deadline, whichever lands first
+                expired = (p.deadline_epoch is not None
+                           and time.time() > p.deadline_epoch)
+                patience_over = (
+                    p.unplaced_since is not None
+                    and now - p.unplaced_since
+                    > self.placement_patience_s)
+                if expired or patience_over:
+                    if not p.future.done():
+                        p.future.set_exception(FleetUnavailableError(
+                            f"request {p.rid!r} could not be re-placed "
+                            "on any healthy replica"
+                            + (" before its deadline" if expired else
+                               f" within {self.placement_patience_s:g}s"),
+                            retry_after_s=1.0))
+                    with self._lock:
+                        self._counters["failed"] += 1
+                        self._retire(p.rid, "")
+                        p.answered = True
+                        self._pending.pop(p.rid, None)
+                    continue
+                self._reroute(p, exclude=(), counter="rerouted")
+                continue
+            # per-request watchdog: the replica heartbeats but this
+            # request has sat beyond the bound (batcher wedged, round
+            # starving) — count it against the breaker and mirror the
+            # request elsewhere; first answer still wins
+            if self.request_timeout_s is not None and \
+                    not any(r.kind == "failover" for r in p.routes):
+                for route in live:
+                    if now - route.t > self.request_timeout_s:
+                        self.breakers.record(route.replica, False)
+                        with self._lock:
+                            self._counters["watchdog_reroutes"] += 1
+                        self._reroute(p, exclude={route.replica},
+                                      counter="rerouted")
+                        break
+            # hedging: deadline-pressured and slow -> mirror once
+            if self.hedging and p.deadline_s is not None and \
+                    not any(r.kind == "hedge" for r in p.routes) and \
+                    len(self.replicas) > 1:
+                hedge_at = p.t_submit + max(
+                    self.hedge_min_wait_s,
+                    self.hedge_wait_frac * float(p.deadline_s))
+                if now >= hedge_at:
+                    with self._lock:
+                        exclude = {r.replica for r in p.routes}
+                        target = self._route(p, kind="hedge",
+                                             exclude=exclude)
+                        if target is not None:
+                            self._counters["hedged"] += 1
+                    if target is not None and self.journal is not None:
+                        self.journal.note("hedged", p.rid, to=target)
+
+    # -- observability --------------------------------------------------
+    def metrics(self) -> Dict:
+        import numpy as np
+        with self._lock:
+            lat = np.asarray(self._latencies, dtype=float)
+            fol = np.asarray(self._failover_latencies, dtype=float)
+            counters = dict(self._counters)
+            inflight = dict(self._inflight)
+            pending = len(self._pending)
+        aff_total = counters["affinity_hits"] + counters["affinity_misses"]
+        replicas = {}
+        now = time.time()
+        for name, h in self.replicas.items():
+            hb = h.heartbeat()
+            replicas[name] = {
+                **h.snapshot(),
+                "breaker": self.breakers.get(name).snapshot(),
+                "inflight": inflight.get(name, 0),
+                "heartbeat_age_s": (round(now - float(hb["t"]), 3)
+                                    if hb and "t" in hb else None),
+                "heartbeat": hb,
+                "memory_handoffs_received":
+                    self._memory_handoffs.get(name, 0),
+            }
+        pct = (lambda a, q: round(float(np.percentile(a, q)), 4)
+               if a.size else None)
+        return {
+            "replicas": replicas,
+            "routing": {**counters,
+                        "pending": pending,
+                        "affinity_hit_rate": (
+                            round(counters["affinity_hits"] / aff_total, 4)
+                            if aff_total else None)},
+            "latency_s": {"n": int(lat.size), "p50": pct(lat, 50),
+                          "p99": pct(lat, 99),
+                          "max": (round(float(lat.max()), 4)
+                                  if lat.size else None)},
+            "failover_latency_s": {
+                "n": int(fol.size), "p50": pct(fol, 50),
+                "p99": pct(fol, 99),
+                "max": (round(float(fol.max()), 4)
+                        if fol.size else None)},
+        }

@@ -302,6 +302,25 @@ class ScenarioService:
         cases = Params.initialize(path, base_path=base_path)
         return self.submit(cases, **kwargs)
 
+    def submit_pickle(self, path, **kwargs) -> Future:
+        """Admit a fleet-transport request payload: a pickle of
+        ``{"cases": {...}, "priority": int, "deadline_epoch": float}``
+        (see :meth:`~dervet_tpu.service.fleet.SpoolReplica.
+        encode_payload`).  A same-trust-domain transport — the payload
+        was written by our own router process on our own host/cluster,
+        never by an external client.  The deadline rides as an absolute
+        epoch so time spent in transit between router and replica counts
+        against it."""
+        import pickle
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        deadline_epoch = payload.get("deadline_epoch")
+        kwargs.setdefault("priority", int(payload.get("priority", 0)))
+        if deadline_epoch is not None:
+            kwargs.setdefault("deadline_s",
+                              max(0.0, float(deadline_epoch) - time.time()))
+        return self.submit(payload["cases"], **kwargs)
+
     def submit_design_file(self, path, base_path=None, **kwargs) -> Future:
         """Admit a spool ``design.json`` request file (see
         ``design.service.parse_design_request`` for the shape); parse
@@ -614,6 +633,14 @@ class ScenarioService:
         self.close()
 
     # -- observability --------------------------------------------------
+    def request_counters(self) -> Dict:
+        """Cheap request counters for the replica heartbeat (the full
+        :meth:`metrics` walks percentile arrays — too heavy to run every
+        heartbeat tick)."""
+        with self._metrics_lock:
+            return {"completed": self._requests["completed"],
+                    "failed": self._requests["failed"]}
+
     def metrics(self) -> Dict:
         """Service-level metrics: queue depth/rejects, request counts,
         latency percentiles, batch occupancy, compile-cache hits."""
@@ -699,6 +726,7 @@ def serve_main(argv=None) -> int:
     manifests under ``--checkpoint-dir``); a second signal aborts."""
     import argparse
     import json
+    import os
 
     from ..utils.supervisor import atomic_write
 
@@ -730,14 +758,31 @@ def serve_main(argv=None) -> int:
     parser.add_argument("--once", action="store_true",
                         help="serve the files already in incoming/, "
                              "then drain and exit (smoke/CI mode)")
+    # fleet-replica surface (service/fleet.py + router.py): heartbeats,
+    # probe echo, hedge-cancel markers, warm-start memory handoff
+    parser.add_argument("--heartbeat-s", type=float, default=0.5,
+                        help="rewrite heartbeat.json at this cadence "
+                             "(the fleet router's liveness signal; "
+                             "0 disables)")
+    parser.add_argument("--replica-name", default=None,
+                        help="name this replica reports in heartbeats")
+    parser.add_argument("--memory-export-s", type=float, default=2.0,
+                        help="publish the warm-start memory export at "
+                             "this cadence when it changed (failover "
+                             "handoff; 0 disables)")
     args = parser.parse_args(argv)
+
+    from . import fleet as fleet_mod
 
     spool = Path(args.spool_dir)
     incoming = spool / "incoming"
     results_root = spool / "results"
     done_dir = spool / "done"
     failed_dir = spool / "failed"
-    for d in (incoming, results_root, done_dir, failed_dir):
+    cancel_dir = spool / fleet_mod.CANCEL_DIR
+    memory_in = spool / fleet_mod.MEMORY_IN_DIR
+    for d in (incoming, results_root, done_dir, failed_dir, cancel_dir,
+              memory_in):
         d.mkdir(parents=True, exist_ok=True)
 
     # crash-safe journal: every admission/completion is an fsync'd
@@ -756,6 +801,90 @@ def serve_main(argv=None) -> int:
         checkpoint_dir=args.checkpoint_dir or spool / "checkpoints")
     service.start()
     pending: Dict[str, Future] = {}
+
+    # -- fleet-replica machinery (no-ops for a solo serve loop) ---------
+    import pickle
+
+    from ..utils import faultinject
+
+    admissions = 0              # spool admissions this process has made
+    hb_state = {"last": 0.0, "mem_last": 0.0, "mem_stores": -1}
+
+    def write_heartbeat() -> None:
+        """Liveness signal for the fleet router: rewritten atomically on
+        the SCAN thread, so it keeps beating while the batcher solves —
+        a wedged scan loop (or a dead process) is exactly what stops it.
+        Echoes the router's probe nonce (breaker half-open probes cost a
+        file read, not a solve)."""
+        nonce = None
+        try:
+            nonce = json.loads(
+                (spool / fleet_mod.PROBE_FILE).read_text()).get("nonce")
+        except (OSError, ValueError):
+            pass
+        mem = service.solver_cache.memory
+        atomic_write(spool / fleet_mod.HEARTBEAT_FILE, json.dumps({
+            "t": round(time.time(), 3),
+            "pid": os.getpid(),
+            "name": args.replica_name,
+            "draining": service.supervisor.stop_requested(),
+            "pending": len(pending),
+            "queue_depth": service.queue.depth(),
+            **service.request_counters(),
+            # lock-free approximate reads on purpose: structures_cached
+            # wants the solver-cache lock, which get() holds through a
+            # multi-second preconditioning build — a heartbeat that
+            # blocks on a cold round reads as a dead replica
+            "structures": len(service.solver_cache.solvers),
+            "memory_entries": (len(mem._entries)
+                               if mem is not None else 0),
+            "probe_nonce": nonce,
+        }))
+
+    def sync_memory() -> None:
+        """Warm-start memory handoff, both directions: install exports
+        the router dropped into ``memory_in/`` (a dead sibling's
+        converged iterates — imported exact-only, so the failover
+        re-solve ships verbatim bytes or runs cold, never a bit-shifting
+        near seed), and publish this replica's own export when it
+        changed since the last publish."""
+        mem = service.solver_cache.memory
+        if mem is None:
+            return
+        for f in sorted(memory_in.glob("*.pkl")):
+            try:
+                n = mem.import_entries(pickle.loads(f.read_bytes()))
+                TellUser.info(f"serve: imported {n} warm-start entr"
+                              f"{'y' if n == 1 else 'ies'} from "
+                              f"{f.name} (exact-only)")
+            except Exception as e:
+                TellUser.warning(
+                    f"serve: warm-start import {f.name} unreadable "
+                    f"({e}) — discarded")
+            f.unlink(missing_ok=True)
+        now = time.monotonic()
+        if args.memory_export_s and \
+                now - hb_state["mem_last"] >= args.memory_export_s:
+            hb_state["mem_last"] = now
+            stores = mem.snapshot()["stores"]
+            if stores != hb_state["mem_stores"]:
+                hb_state["mem_stores"] = stores
+                atomic_write(spool / fleet_mod.MEMORY_EXPORT_FILE,
+                             pickle.dumps(
+                                 mem.export_entries(),
+                                 protocol=pickle.HIGHEST_PROTOCOL))
+
+    def replica_tick() -> None:
+        nonlocal admissions
+        # replica_hang drill: the sleep lands HERE, on the heartbeat
+        # thread — the process stays alive, heartbeats stop
+        faultinject.maybe_replica_hang(admissions)
+        now = time.monotonic()
+        if args.heartbeat_s and \
+                now - hb_state["last"] >= args.heartbeat_s:
+            hb_state["last"] = now
+            write_heartbeat()
+        sync_memory()
 
     def _error_payload(err: BaseException) -> dict:
         """Uniform machine-readable error record (the typed-error
@@ -807,10 +936,12 @@ def serve_main(argv=None) -> int:
     # SIGTERM/SIGINT -> graceful drain + exit 0, second -> abort
     with service.supervisor:
         while not service.supervisor.stop_requested():
+            replica_tick()
             submitted_any = False
             deferred = False
             for path in sorted(incoming.glob("*")):
-                if path.suffix.lower() not in (".csv", ".json", ".xml"):
+                if path.suffix.lower() not in (".csv", ".json", ".xml",
+                                               ".pkl"):
                     continue
                 # file stems become request ids, which name artifact
                 # files — sanitize to the admission-safe alphabet (two
@@ -820,7 +951,33 @@ def serve_main(argv=None) -> int:
                              path.stem)[:64] or "req"
                 if rid in pending:
                     continue
+                # hedge-loser cancellation (fleet router): a cancel
+                # marker retracts the input BEFORE admission — the
+                # round-boundary contract; once admitted, the round
+                # finishes and the router discards the answer
+                if (cancel_dir / rid).exists():
+                    journal.note("cancelled", rid)
+                    path.unlink(missing_ok=True)
+                    (cancel_dir / rid).unlink(missing_ok=True)
+                    TellUser.info(f"serve: {rid} retracted by cancel "
+                                  "marker before admission")
+                    continue
                 try:
+                    if path.suffix.lower() == ".pkl":
+                        # fleet transport: pickled cases payload from
+                        # the router (same trust domain)
+                        fut = service.submit_pickle(path, request_id=rid)
+                        pending[rid] = fut
+                        journal.admitted(rid, path.name)
+                        admissions += 1
+                        fut.add_done_callback(
+                            lambda f, p=path, r=rid: _finish(p, r, f))
+                        submitted_any = True
+                        # replica_crash drill: hard-exit (SIGKILL-like)
+                        # right after the journal recorded the admission
+                        # — the batch this request joined is in flight
+                        faultinject.maybe_replica_crash(admissions)
+                        continue
                     # a JSON file with a top-level "design" object is a
                     # BOOST design request (base case + bounds spec),
                     # not a model-parameters file
@@ -858,9 +1015,11 @@ def serve_main(argv=None) -> int:
                     continue
                 pending[rid] = fut
                 journal.admitted(rid, path.name)
+                admissions += 1
                 fut.add_done_callback(
                     lambda f, p=path, r=rid: _finish(p, r, f))
                 submitted_any = True
+                faultinject.maybe_replica_crash(admissions)
             if args.once:
                 if deferred and not service.supervisor.stop_requested():
                     # --once must still serve EVERY input: rescan the
@@ -871,11 +1030,15 @@ def serve_main(argv=None) -> int:
                 for fut in list(pending.values()):
                     while not fut.done() and \
                             not service.supervisor.stop_requested():
+                        replica_tick()
                         time.sleep(0.05)
                 break
             if not submitted_any:
-                service.supervisor.wait_stop(args.poll_s)
+                service.supervisor.wait_stop(
+                    min(args.poll_s, args.heartbeat_s or args.poll_s))
         service.drain()
+        if args.heartbeat_s:
+            write_heartbeat()   # final beat advertises draining=True
     journal.close()
     metrics = service.metrics()
     atomic_write(spool / "service_metrics.json",

@@ -147,6 +147,25 @@ class CircuitBreaker:
                     f"over last {len(self._events)}) — path cut off for "
                     f"{self.cooldown_s:g}s, then half-open probe")
 
+    def trip(self, reason: str = "") -> None:
+        """Force the breaker OPEN immediately (fresh cooldown), bypassing
+        the sliding-window rate.  For failures that are conclusive on
+        their own — the fleet router confirming a replica dead (process
+        exited / heartbeats stopped) must cut routing NOW, not after the
+        window's failure rate catches up with reality."""
+        with self._lock:
+            if self.state != self.OPEN:
+                self.trips += 1
+            self.state = self.OPEN
+            self._opened_at = self._clock()
+            self._probe_inflight = False
+            self._events.clear()
+            TellUser.warning(
+                f"breaker {self.name!r}: force-TRIPPED"
+                + (f" ({reason})" if reason else "")
+                + f" — path cut off for {self.cooldown_s:g}s, then "
+                "half-open probe")
+
     # ------------------------------------------------------------------
     def probe_in_s(self) -> Optional[float]:
         """Seconds until the next half-open probe (None unless open)."""
@@ -204,6 +223,9 @@ class BreakerBoard:
 
     def record(self, name: str, success: bool) -> None:
         self.get(name).record(success)
+
+    def trip(self, name: str, reason: str = "") -> None:
+        self.get(name).trip(reason)
 
     def is_open(self, name: str) -> bool:
         """True while the named path is cut off (no probe due yet).

@@ -182,6 +182,36 @@ class BreakerOpenError(ServiceError):
         self.retry_hint = probe_in_s
 
 
+class FleetUnavailableError(QueueFullError):
+    """The fleet router could not place the request on ANY replica:
+    every healthy replica rejected it (queue full / inflight bound) or
+    every replica's breaker is open.  A :class:`QueueFullError` subclass
+    on purpose — the routing hop must not launder the per-replica
+    drain-rate hint into an untyped error, so ``retry_after_s`` carries
+    the SMALLEST hint any replica offered and existing client backoff
+    discipline (capped, ±25% jittered) applies unchanged."""
+
+    kind = "fleet_unavailable"
+
+
+class ReplicaAnswerError(ServiceError):
+    """A spool replica answered the request with a typed failure; the
+    router re-raises it on the client future with the replica's
+    machine-readable payload attached (``payload``: the ``as_dict``
+    record from the replica's ``.error.json``).  The replica's own
+    ``retry_hint`` rides through the routing hop."""
+
+    kind = "replica_request_failed"
+
+    def __init__(self, msg: str, payload: Optional[Dict] = None,
+                 replica: Optional[str] = None):
+        super().__init__(msg)
+        self.payload = dict(payload or {})
+        self.replica = replica
+        hint = self.payload.get("retry_hint")
+        self.retry_hint = float(hint) if hint is not None else None
+
+
 class TariffError(Exception):
     """Customer tariff missing or malformed."""
 

@@ -109,6 +109,27 @@ Two activation paths:
                                          must steal the straggler's
                                          queued groups and the round
                                          must finish correct
+      DERVET_TPU_FAULT_REPLICA_CRASH=2   fleet replica drill: the serve
+                                         loop hard-exits (``os._exit``,
+                                         the SIGKILL analogue — no drain,
+                                         no atexit, no journal flush
+                                         beyond what already fsync'd)
+                                         once 2 spool requests have been
+                                         admitted — exercises the fleet
+                                         router's death detection +
+                                         journal failover path
+                                         deterministically; one-shot via
+                                         the env-plan memo
+      DERVET_TPU_FAULT_REPLICA_HANG=2    fleet replica drill: the serve
+                                         SCAN loop (the thread that
+                                         writes heartbeats) sleeps
+                                         DERVET_TPU_FAULT_REPLICA_HANG_S
+                                         (default 3600 s) once 2 requests
+                                         have been admitted — heartbeats
+                                         stop while the process stays
+                                         alive, the shape of failure only
+                                         the router's missed-heartbeat
+                                         watchdog can see; one-shot
       DERVET_TPU_FAULT_POISON=rid.0      poison-REQUEST crash: dispatching
                                          the targeted case raises an
                                          injected crash EVERY time it is
@@ -153,6 +174,8 @@ EVENT_DEVICE_LOSS = "device_loss"   # backend death raised mid-solve
 EVENT_POISON_CASE = "poison_case"   # targeted case crashes its dispatch
 EVENT_STALE_SEED = "stale_seed"     # warm-start seed corrupted pre-solve
 EVENT_STRAGGLER = "straggler"       # one device's solves slowed (elastic)
+EVENT_REPLICA_CRASH = "replica_crash"   # serve loop hard-exits (SIGKILL-like)
+EVENT_REPLICA_HANG = "replica_hang"     # serve loop sleeps; heartbeats stop
 
 
 class InjectedCrashError(RuntimeError):
@@ -199,7 +222,10 @@ class FaultPlan:
                  stale_seed_scale: float = 0.5,
                  straggler: bool = False,
                  straggler_device: int = 0,
-                 straggler_seconds: float = 0.75):
+                 straggler_seconds: float = 0.75,
+                 replica_crash_after: Optional[int] = None,
+                 replica_hang_after: Optional[int] = None,
+                 replica_hang_seconds: float = 3600.0):
         self.nonconverge = _norm(nonconverge)
         self.rungs = _norm(rungs)
         self.poison_cases = _norm(poison_cases)
@@ -249,6 +275,18 @@ class FaultPlan:
         self.straggler = bool(straggler)
         self.straggler_device = int(straggler_device)
         self.straggler_seconds = float(straggler_seconds)
+        # replica_crash / replica_hang (fleet failover drills): fire once
+        # the serve loop has admitted N spool requests — "mid-round" by
+        # construction, since the batch those admissions joined is still
+        # in flight when the Nth admission lands.  Both are one-shot (the
+        # env-plan memo keeps this plan object alive across hook calls).
+        self.replica_crash_after = (None if replica_crash_after is None
+                                    else int(replica_crash_after))
+        self.replica_hang_after = (None if replica_hang_after is None
+                                   else int(replica_hang_after))
+        self.replica_hang_seconds = float(replica_hang_seconds)
+        self._replica_crash_fired = False
+        self._replica_hang_fired = False
         self._preempt_fired = False
         self.fired: List[Tuple[str, str]] = []   # (rung/event, label/case)
 
@@ -342,6 +380,26 @@ class FaultPlan:
             return True
         return False
 
+    def replica_crash_due(self, admissions_done: int) -> bool:
+        """Should the serve loop hard-exit now (``admissions_done`` spool
+        requests admitted so far)?  One-shot."""
+        if self.replica_crash_after is None or self._replica_crash_fired \
+                or admissions_done < self.replica_crash_after:
+            return False
+        self._replica_crash_fired = True
+        self.fired.append((EVENT_REPLICA_CRASH, str(admissions_done)))
+        return True
+
+    def replica_hang_seconds_due(self, admissions_done: int) -> float:
+        """Seconds the serve scan loop should wedge for (0 when the
+        ``replica_hang`` fault is off / not yet due / already fired)."""
+        if self.replica_hang_after is None or self._replica_hang_fired \
+                or admissions_done < self.replica_hang_after:
+            return 0.0
+        self._replica_hang_fired = True
+        self.fired.append((EVENT_REPLICA_HANG, str(admissions_done)))
+        return self.replica_hang_seconds
+
     def preempt_due(self, batches_done: int) -> bool:
         if self.preempt_after is None or self._preempt_fired or \
                 batches_done < self.preempt_after:
@@ -371,7 +429,10 @@ _ENV_VARS = ("DERVET_TPU_FAULT_NONCONVERGE", "DERVET_TPU_FAULT_POISON_CASE",
              "DERVET_TPU_FAULT_STALE_SEED_SCALE",
              "DERVET_TPU_FAULT_STRAGGLER",
              "DERVET_TPU_FAULT_STRAGGLER_DEVICE",
-             "DERVET_TPU_FAULT_STRAGGLER_S")
+             "DERVET_TPU_FAULT_STRAGGLER_S",
+             "DERVET_TPU_FAULT_REPLICA_CRASH",
+             "DERVET_TPU_FAULT_REPLICA_HANG",
+             "DERVET_TPU_FAULT_REPLICA_HANG_S")
 _ENV_PLAN: Optional[FaultPlan] = None
 _ENV_SNAPSHOT: Optional[tuple] = None
 
@@ -392,8 +453,10 @@ def _plan_from_env() -> Optional[FaultPlan]:
     ss = os.environ.get("DERVET_TPU_FAULT_STALE_SEED")
     st = os.environ.get("DERVET_TPU_FAULT_STRAGGLER", "").strip().lower()
     st_on = st not in ("", "0", "false", "off")
+    rcr = os.environ.get("DERVET_TPU_FAULT_REPLICA_CRASH")
+    rhg = os.environ.get("DERVET_TPU_FAULT_REPLICA_HANG")
     if not (nc or pc or cf or hg or sl or pa or cr or ov_on or dl_on
-            or crash or ss or st_on):
+            or crash or ss or st_on or rcr or rhg):
         return None
     ov_n = os.environ.get("DERVET_TPU_FAULT_OVERLOAD_N")
     rungs = os.environ.get("DERVET_TPU_FAULT_RUNGS", RUNG_SOLVE)
@@ -423,7 +486,11 @@ def _plan_from_env() -> Optional[FaultPlan]:
         straggler_device=int(
             os.environ.get("DERVET_TPU_FAULT_STRAGGLER_DEVICE", 0)),
         straggler_seconds=float(
-            os.environ.get("DERVET_TPU_FAULT_STRAGGLER_S", 0.75)))
+            os.environ.get("DERVET_TPU_FAULT_STRAGGLER_S", 0.75)),
+        replica_crash_after=int(rcr) if rcr else None,
+        replica_hang_after=int(rhg) if rhg else None,
+        replica_hang_seconds=float(
+            os.environ.get("DERVET_TPU_FAULT_REPLICA_HANG_S", 3600)))
 
 
 def get_plan() -> Optional[FaultPlan]:
@@ -566,6 +633,33 @@ def maybe_crash_case(case_id) -> None:
     if plan is not None and plan.should_crash(case_id):
         raise InjectedCrashError(
             f"fault injection: poison request crash (case {case_id})")
+
+
+def maybe_replica_crash(admissions_done: int) -> None:
+    """``replica_crash`` injection point in the serve scan loop, checked
+    after each spool admission: when due, the process hard-exits via
+    ``os._exit`` — the closest in-process analogue of a SIGKILL (no
+    drain, no atexit, no buffered writes beyond what already fsync'd) —
+    so the fleet router's missed-heartbeat death detection and
+    journal-based failover run against a genuinely unclean death."""
+    plan = get_plan()
+    if plan is not None and plan.replica_crash_due(admissions_done):
+        os._exit(2)
+
+
+def maybe_replica_hang(admissions_done: int) -> float:
+    """``replica_hang`` injection point at the top of the serve scan
+    loop (the thread that writes heartbeats): when due, sleep — the
+    process stays alive, its batcher may even finish in-flight work, but
+    heartbeats stop; only the router's staleness watchdog can tell.
+    Returns the seconds slept (0 in the no-plan fast path)."""
+    plan = get_plan()
+    if plan is None:
+        return 0.0
+    secs = plan.replica_hang_seconds_due(admissions_done)
+    if secs > 0:
+        time.sleep(secs)
+    return secs
 
 
 def maybe_preempt(batches_done: int) -> bool:

@@ -1,0 +1,259 @@
+"""CI smoke: the multi-replica fleet under a real SIGKILL, exactly-once.
+
+Boots a 3-replica fleet (real ``dervet-tpu serve`` subprocesses over
+file spools, CPU backend), routes a mixed-structure workload through
+:class:`~dervet_tpu.service.router.FleetRouter`, and SIGKILLs one
+replica mid-round.  The serving contract under fire:
+
+* **0 lost** — every request's future resolves (the dead replica's
+  in-flight requests are recovered from its journal + spool and
+  re-routed or harvested);
+* **0 duplicated** — each request is DELIVERED exactly once (late
+  answers from the killed replica are suppressed, never double-served);
+* **100% certified** — every delivered run-health slice carries a full
+  complement of accepted float64 certificates, recovered requests
+  included;
+* **byte-identical** — the full result-CSV surface matches the same
+  workload served by a single-replica fleet (failover changes WHERE a
+  request solves, never what it solves to);
+* **failover < deadline** — every request answered inside its deadline
+  despite the kill, and the router's failover-latency metric is bounded;
+* **visible** — the dead replica's breaker is open and the failover /
+  reroute / harvest counters are nonzero in ``FleetRouter.metrics()``.
+
+A second wave of identical-content requests then exercises the warm
+tier: structure-fingerprint affinity hits and (replica-local) exact
+warm-start repeats, still byte-identical.
+
+Env knobs: SMOKE_FLEET_REQUESTS (default 6), SMOKE_FLEET_DEADLINE_S
+(default 300), SMOKE_FLEET_SLOW_S (default 0.75 — per-solve injected
+delay so the SIGKILL reliably lands mid-round).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_REQ = int(os.environ.get("SMOKE_FLEET_REQUESTS", "6"))
+DEADLINE_S = float(os.environ.get("SMOKE_FLEET_DEADLINE_S", "300"))
+SLOW_S = os.environ.get("SMOKE_FLEET_SLOW_S", "0.75")
+
+
+def log(msg: str) -> None:
+    print(f"fleet-smoke: {msg}", file=sys.stderr, flush=True)
+
+
+def workload():
+    """N requests, one case each: DISTINCT window lengths (distinct LP
+    structures — cross-request warm seeding cannot blur the byte-
+    identity gate) and distinct battery ratings (distinct content)."""
+    from dervet_tpu.benchlib import synthetic_sensitivity_cases
+    out = {}
+    for i in range(N_REQ):
+        case = synthetic_sensitivity_cases(1, n=72 + 24 * i, months=1)[0]
+        for tag, _, keys in case.ders:
+            if tag == "Battery":
+                keys["ene_max_rated"] = 8000.0 + 10.0 * i
+        out[f"req{i:02d}"] = {0: case}
+    return out
+
+
+def spawn_fleet(root: Path, n: int, tag: str):
+    from dervet_tpu.service import spawn_replica
+    # every replica (reference included) carries the same slow-solve
+    # fault so the two passes stay byte-comparable and the kill lands
+    # mid-round; the delay is outside the solver — correctness untouched
+    env = {"DERVET_TPU_FAULT_SLOW": "all",
+           "DERVET_TPU_FAULT_SLOW_S": SLOW_S}
+    reps = []
+    for i in range(n):
+        name = f"{tag}{i}"
+        logf = open(root / f"{name}.log", "w")
+        reps.append(spawn_replica(root / name, name=name, backend="cpu",
+                                  stdout=logf, stderr=logf, env=env))
+    return reps
+
+
+def route_wave(router, reqs, rid_prefix=""):
+    futs = {}
+    for rid, cases in reqs.items():
+        futs[rid_prefix + rid] = router.submit(
+            cases, request_id=rid_prefix + rid, deadline_s=DEADLINE_S)
+    return futs
+
+
+def collect(futs, timeout=600):
+    out = {}
+    for rid, fut in futs.items():
+        out[rid] = fut.result(timeout=timeout)
+    return out
+
+
+def csv_surface(results_dir: Path):
+    return {p.name: p.read_bytes()
+            for p in sorted(results_dir.glob("*.csv"))}
+
+
+def assert_certified(rid, res):
+    rh = res.load_run_health()
+    assert rh is not None, f"{rid}: no run-health slice"
+    cert = rh["certification"]
+    assert cert["enabled"], f"{rid}: certification disabled"
+    assert cert["windows"]["rejected_final"] == 0, \
+        f"{rid}: final certificate rejections"
+    # 100% coverage: every window the ledger slice dispatched carries an
+    # accepted certificate
+    ledger = json.loads(
+        (res.results_dir / f"solve_ledger.{res.rid}.json").read_text())
+    n_windows = ledger["totals"]["windows"]
+    assert cert["windows_certified"] == n_windows > 0, \
+        f"{rid}: {cert['windows_certified']}/{n_windows} windows " \
+        "certified (acceptance: 100%)"
+
+
+def main() -> int:
+    import tempfile
+
+    from dervet_tpu.service import FleetRouter, ServiceJournal
+
+    workdir = Path(tempfile.mkdtemp(prefix="fleet-smoke-"))
+    report = {"requests": N_REQ}
+
+    # ---- reference pass: the same workload on a single replica -------
+    log("reference pass: 1 replica …")
+    ref_root = workdir / "ref"
+    ref_root.mkdir()
+    ref_reps = spawn_fleet(ref_root, 1, "ref")
+    ref_router = FleetRouter(ref_reps, fleet_dir=ref_root / "fleet",
+                             heartbeat_timeout_s=5.0).start()
+    t0 = time.time()
+    ref_results = collect(route_wave(ref_router, workload()))
+    report["reference_wall_s"] = round(time.time() - t0, 1)
+    ref_csvs = {rid: csv_surface(r.results_dir)
+                for rid, r in ref_results.items()}
+    ref_router.close()
+    log(f"reference: {len(ref_results)} requests in "
+        f"{report['reference_wall_s']}s")
+
+    # ---- fleet pass: 3 replicas, SIGKILL one mid-round ---------------
+    log("fleet pass: 3 replicas …")
+    fleet_root = workdir / "fleet"
+    fleet_root.mkdir()
+    reps = spawn_fleet(fleet_root, 3, "r")
+    router = FleetRouter(reps, fleet_dir=fleet_root / "router",
+                         heartbeat_timeout_s=3.0, tick_s=0.05).start()
+    futs = route_wave(router, workload())
+
+    # pick the victim: a replica with >= 1 COMPLETED request (so its
+    # warm-start export exists for the handoff) and >= 1 admitted
+    # request still unfinished (so the kill genuinely lands mid-round)
+    victim = None
+    kill_deadline = time.time() + 240
+    while victim is None and time.time() < kill_deadline:
+        for rep in reps:
+            states = ServiceJournal.replay_path(
+                rep.spool / "service_journal.jsonl")
+            done = sum(1 for e in states.values()
+                       if e["state"] == "completed")
+            inflight = sum(1 for e in states.values()
+                           if e["state"] == "admitted")
+            if done >= 1 and inflight >= 1 and \
+                    (rep.spool / "memory_export.pkl").exists():
+                victim = rep
+                break
+        time.sleep(0.05)
+    assert victim is not None, \
+        "no replica reached completed>=1 + inflight>=1 before the " \
+        "workload drained — kill window missed"
+    t_kill = time.time()
+    victim.process.send_signal(signal.SIGKILL)
+    log(f"SIGKILLed replica {victim.name} (pid {victim.process.pid}) "
+        "mid-round")
+
+    results = collect(futs)
+    t_all = time.time()
+
+    # ---- the contract -------------------------------------------------
+    assert set(results) == set(ref_results), "lost requests"
+    recovered = [rid for rid, r in results.items() if r.recovered]
+    assert recovered, "kill drill produced no recovered request — the " \
+        "victim had nothing in flight (drill is vacuous)"
+    byte_identical = True
+    for rid, res in results.items():
+        assert_certified(rid, res)
+        got = csv_surface(res.results_dir)
+        ref = ref_csvs[rid]
+        assert sorted(got) == sorted(ref) and got, \
+            f"{rid}: CSV file set differs from single-replica run"
+        for name in ref:
+            if got[name] != ref[name]:
+                byte_identical = False
+                log(f"BYTE MISMATCH {rid}/{name} "
+                    f"(served by {res.replica}, "
+                    f"recovered={res.recovered})")
+    assert byte_identical, "fleet results not byte-identical to the " \
+        "single-replica run"
+
+    m = router.metrics()
+    r = m["routing"]
+    assert r["failovers"] >= 1, r
+    assert r["rerouted"] + r["harvested"] >= 1, r
+    assert m["replicas"][victim.name]["state"] == "dead"
+    assert m["replicas"][victim.name]["breaker"]["state"] == "open", \
+        m["replicas"][victim.name]["breaker"]
+    # exactly-once at the delivery layer: completed counts every rid
+    # once, and nothing was double-delivered (a second set_result would
+    # have raised InvalidStateError inside the router)
+    assert r["completed"] == N_REQ, r
+    assert r["failed"] == 0, r
+    failover_wall = t_all - t_kill
+    assert failover_wall < DEADLINE_S, \
+        f"failover took {failover_wall:.0f}s (deadline {DEADLINE_S:g}s)"
+    report.update({
+        "victim": victim.name,
+        "recovered_requests": recovered,
+        "harvested": r["harvested"], "rerouted": r["rerouted"],
+        "duplicates_suppressed": r["duplicates_suppressed"],
+        "memory_handoffs": r["memory_handoffs"],
+        "failover_wall_s": round(failover_wall, 1),
+        "failover_latency_s": m["failover_latency_s"],
+        "byte_identical": byte_identical,
+    })
+    log(f"kill drill OK: {len(recovered)} recovered "
+        f"({r['harvested']} harvested, {r['rerouted']} rerouted, "
+        f"{r['memory_handoffs']} memory handoffs), failover wall "
+        f"{failover_wall:.1f}s, byte-identical")
+
+    # ---- wave 2: affinity + warm repeats on the surviving fleet ------
+    log("wave 2: identical content, new ids …")
+    futs2 = route_wave(router, workload(), rid_prefix="w2.")
+    results2 = collect(futs2)
+    for rid, res in results2.items():
+        assert_certified(rid, res)
+        got = csv_surface(res.results_dir)
+        ref = ref_csvs[rid[len("w2."):]]
+        for name in ref:
+            assert got[name] == ref[name], \
+                f"wave2 {rid}/{name}: bytes differ from reference"
+    m2 = router.metrics()
+    assert m2["routing"]["affinity_hits"] >= 1, \
+        "no affinity hit on the repeat wave"
+    report["affinity_hit_rate"] = m2["routing"]["affinity_hit_rate"]
+    router.close()
+
+    report["ok"] = True
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,790 @@
+"""Fleet serving tests: structure-affinity routing, health-probed
+failover, exactly-once recovery, hedging, and the retry-after redirect
+discipline (service/fleet.py + service/router.py).
+
+Three tiers:
+
+* stub-replica tests — a minimal in-memory :class:`ReplicaHandle` gives
+  precise control over answers/heartbeats, so routing, failover,
+  watchdog, hedging, and duplicate-suppression logic are exercised in
+  milliseconds;
+* local-replica tests — real :class:`ScenarioService` instances behind
+  :class:`LocalReplica` handles prove the routed path end-to-end in
+  process (cpu backend);
+* subprocess tests — a real ``serve`` replica process under the
+  ``replica_crash`` fault drills the death-detection + journal-failover
+  path against a genuinely unclean exit.
+"""
+import json
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dervet_tpu.benchlib import synthetic_sensitivity_cases
+from dervet_tpu.ops.lp import LP
+from dervet_tpu.ops.warmstart import SolutionMemory
+from dervet_tpu.service import (FleetRouter, FleetUnavailableError,
+                                LocalReplica, QueueFullError,
+                                ScenarioClient, ScenarioService,
+                                ServiceJournal)
+from dervet_tpu.service.fleet import ReplicaHandle, structure_fingerprint
+from dervet_tpu.utils import faultinject
+from dervet_tpu.utils.breaker import CircuitBreaker
+
+
+def _cases(n=1, window=None, months=1, variant=0):
+    kwargs = {"months": months}
+    if window is not None:
+        kwargs["n"] = window
+    cases = synthetic_sensitivity_cases(n, **kwargs)
+    for c in cases:
+        for tag, _, keys in c.ders:
+            if tag == "Battery":
+                keys["ene_max_rated"] = \
+                    float(keys["ene_max_rated"]) + 0.5 * variant
+    return {i: c for i, c in enumerate(cases)}
+
+
+# ---------------------------------------------------------------------------
+# Structure fingerprint (the affinity key)
+# ---------------------------------------------------------------------------
+
+class TestStructureFingerprint:
+    def test_content_invariant(self):
+        # different prices/ratings, same structure -> same fingerprint
+        assert structure_fingerprint(_cases(variant=0)) == \
+            structure_fingerprint(_cases(variant=7))
+
+    def test_window_scheme_changes_it(self):
+        assert structure_fingerprint(_cases(window=72)) != \
+            structure_fingerprint(_cases(window=96))
+
+    def test_der_set_changes_it(self):
+        a = _cases()
+        b = _cases()
+        b[0].ders.pop()             # drop the PV
+        assert structure_fingerprint(a) != structure_fingerprint(b)
+
+    def test_horizon_changes_it(self):
+        assert structure_fingerprint(_cases(months=1)) != \
+            structure_fingerprint(_cases(months=2))
+
+
+# ---------------------------------------------------------------------------
+# Warm-start memory export/import (the failover handoff)
+# ---------------------------------------------------------------------------
+
+def _lp(seed=0, n=6, m=4):
+    import scipy.sparse as sp
+    rng = np.random.default_rng(seed)
+    return LP(c=rng.normal(size=n),
+              K=sp.csr_matrix(rng.normal(size=(m, n))),
+              q=rng.normal(size=m), n_eq=2, l=np.full(n, -10.0),
+              u=np.full(n, 10.0), var_refs={}, row_groups={})
+
+
+class _Opts:
+    eps_abs = 1e-4
+    eps_rel = 1e-4
+    max_iters = 1000
+    inaccurate_factor = 10.0
+    dtype = np.float32
+
+
+class TestMemoryHandoff:
+    def test_export_import_roundtrip_exact_grade(self):
+        from dervet_tpu.ops.warmstart import data_digest, opts_tag
+        mem = SolutionMemory(max_entries=16)
+        lp = _lp()
+        tag = opts_tag(_Opts)
+        mem.store("s1", lp, tag, np.ones(lp.n), np.ones(lp.m), 1.0)
+        blob = pickle.dumps(mem.export_entries())
+
+        other = SolutionMemory(max_entries=16)
+        assert other.import_entries(pickle.loads(blob)) == 1
+        assert other.snapshot()["imported"] == 1
+        entry, kind = other.lookup("s1", lp, tag)
+        assert kind == "exact"
+        np.testing.assert_array_equal(entry.x, np.ones(lp.n))
+        # and the key carries the same digest the donor computed
+        assert entry.exact == data_digest(lp, np.float32)
+
+    def test_exact_only_import_invisible_to_near(self):
+        from dervet_tpu.ops.warmstart import opts_tag
+        mem = SolutionMemory(max_entries=16)
+        tag = opts_tag(_Opts)
+        mem.store("s1", _lp(seed=0), tag, np.ones(6), np.ones(4), 1.0)
+        other = SolutionMemory(max_entries=16)
+        other.import_entries(mem.export_entries())
+        # a NEARBY (not byte-exact) instance must come back cold: a
+        # near-grade seed from imported foreign data would shift the
+        # re-solve's iterate path and break byte-identical failover
+        entry, kind = other.lookup("s1", _lp(seed=1), tag)
+        assert entry is None and kind is None
+        # the donor itself WOULD near-seed it (its own entries are
+        # fully indexed)
+        _, kind_donor = mem.lookup("s1", _lp(seed=1), tag)
+        assert kind_donor == "near"
+
+    def test_import_skips_existing_and_malformed(self):
+        from dervet_tpu.ops.warmstart import opts_tag
+        mem = SolutionMemory(max_entries=16)
+        tag = opts_tag(_Opts)
+        mem.store("s1", _lp(), tag, np.ones(6), np.ones(4), 1.0)
+        payload = mem.export_entries()
+        assert mem.import_entries(payload) == 0       # already present
+        assert SolutionMemory(max_entries=16).import_entries(
+            [("garbage", {"nope": 1})] + payload) == 1
+
+    def test_eviction_unlinks_imported(self):
+        from dervet_tpu.ops.warmstart import opts_tag
+        mem = SolutionMemory(max_entries=16)
+        tag = opts_tag(_Opts)
+        mem.store("s1", _lp(), tag, np.ones(6), np.ones(4), 1.0)
+        tiny = SolutionMemory(max_entries=1)
+        tiny.import_entries(mem.export_entries())
+        assert tiny.snapshot()["imported_live"] == 1
+        tiny.store("s2", _lp(seed=3), tag, np.ones(6), np.ones(4), 2.0)
+        assert tiny.snapshot()["imported_live"] == 0   # evicted cleanly
+
+
+# ---------------------------------------------------------------------------
+# replica_crash / replica_hang fault kinds
+# ---------------------------------------------------------------------------
+
+class TestReplicaFaults:
+    def test_env_knobs_parse_and_one_shot(self, monkeypatch):
+        monkeypatch.setenv("DERVET_TPU_FAULT_REPLICA_CRASH", "3")
+        monkeypatch.setenv("DERVET_TPU_FAULT_REPLICA_HANG", "2")
+        monkeypatch.setenv("DERVET_TPU_FAULT_REPLICA_HANG_S", "0.01")
+        plan = faultinject.get_plan()
+        assert plan.replica_crash_after == 3
+        assert plan.replica_hang_after == 2
+        assert not plan.replica_crash_due(2)
+        assert plan.replica_crash_due(3)
+        assert not plan.replica_crash_due(4)          # one-shot
+        assert plan.replica_hang_seconds_due(1) == 0.0
+        assert plan.replica_hang_seconds_due(2) == 0.01
+        assert plan.replica_hang_seconds_due(5) == 0.0  # one-shot
+        # env-plan memo: the same plan object (with its latches) comes
+        # back on the next hook call
+        assert faultinject.get_plan() is plan
+        assert [e for e, _ in plan.fired] == ["replica_crash",
+                                              "replica_hang"]
+
+    def test_hang_hook_sleeps(self):
+        with faultinject.inject(replica_hang_after=1,
+                                replica_hang_seconds=0.05):
+            t0 = time.monotonic()
+            assert faultinject.maybe_replica_hang(0) == 0.0
+            assert faultinject.maybe_replica_hang(1) == 0.05
+            assert time.monotonic() - t0 >= 0.05
+        assert faultinject.maybe_replica_hang(9) == 0.0   # plan closed
+
+
+# ---------------------------------------------------------------------------
+# Journal: racing recoveries stay idempotent (the satellite drill)
+# ---------------------------------------------------------------------------
+
+class TestJournalRecoveryRace:
+    def _spool(self, tmp_path):
+        incoming = tmp_path / "incoming"
+        done = tmp_path / "done"
+        failed = tmp_path / "failed"
+        for d in (incoming, done, failed):
+            d.mkdir()
+        return incoming, done, failed
+
+    def test_concurrent_recover_spool_idempotent(self, tmp_path):
+        """Router failover firing while the replica restarts: both replay
+        the same journal concurrently.  The interrupted result move must
+        finish exactly once and no request may be re-served twice."""
+        incoming, done, failed = self._spool(tmp_path)
+        jpath = tmp_path / "service_journal.jsonl"
+        seed = ServiceJournal(jpath)
+        # killed between journaling 'completed' and moving the file:
+        seed.admitted("rid-done", "rid-done.pkl")
+        seed.completed("rid-done")
+        (incoming / "rid-done.pkl").write_bytes(b"payload")
+        # killed mid-flight (admitted, no terminal): must be re-served
+        seed.admitted("rid-open", "rid-open.pkl")
+        (incoming / "rid-open.pkl").write_bytes(b"payload")
+        seed.close()
+
+        journals = [ServiceJournal(jpath) for _ in range(2)]
+        outcomes = [None, None]
+        barrier = threading.Barrier(2)
+
+        def recover(i):
+            barrier.wait()
+            outcomes[i] = journals[i].recover_spool(incoming, done,
+                                                    failed)
+
+        threads = [threading.Thread(target=recover, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for j in journals:
+            j.close()
+        assert all(o is not None for o in outcomes), "a recovery crashed"
+        # the interrupted move finished exactly once
+        assert (done / "rid-done.pkl").exists()
+        assert not (incoming / "rid-done.pkl").exists()
+        assert sum("rid-done" in o["moved"] for o in outcomes) == 1
+        # the in-flight request is re-servable (file untouched), and
+        # both recoveries agree on that — re-serving is idempotent by
+        # the atomic-rewrite contract, never a double answer
+        assert (incoming / "rid-open.pkl").exists()
+        assert all("rid-open" in o["reserve"] for o in outcomes)
+        # a third recovery after the dust settles is a no-op move-wise
+        j3 = ServiceJournal(jpath)
+        assert j3.recover_spool(incoming, done, failed)["moved"] == []
+        j3.close()
+
+    def test_cancelled_state_removal_replayed(self, tmp_path):
+        incoming, done, failed = self._spool(tmp_path)
+        j = ServiceJournal(tmp_path / "service_journal.jsonl")
+        j.admitted("hedge-loser", "hedge-loser.pkl")
+        j.note("cancelled", "hedge-loser", file="hedge-loser.pkl")
+        (incoming / "hedge-loser.pkl").write_bytes(b"payload")
+        out = j.recover_spool(incoming, done, failed)
+        j.close()
+        # the kill landed between journaling the cancel and unlinking:
+        # recovery finishes the removal instead of re-serving the loser
+        assert not (incoming / "hedge-loser.pkl").exists()
+        assert "hedge-loser" not in out["reserve"]
+
+    def test_note_events_and_replay_path(self, tmp_path):
+        jpath = tmp_path / "j.jsonl"
+        j = ServiceJournal(jpath)
+        j.note("routed", "r1", replica="a")
+        j.note("rerouted", "r1", to="b")
+        j.completed("r1")
+        j.close()
+        states = ServiceJournal.replay_path(jpath)
+        assert states["r1"]["state"] == "completed"
+        lines = [json.loads(ln) for ln in
+                 jpath.read_text().splitlines()]
+        assert [ln["event"] for ln in lines] == ["routed", "rerouted",
+                                                 "completed"]
+        assert lines[1]["to"] == "b"
+
+
+# ---------------------------------------------------------------------------
+# Router logic against stub replicas
+# ---------------------------------------------------------------------------
+
+class StubReplica(ReplicaHandle):
+    """Scripted replica: answers/heartbeats under test control."""
+
+    def __init__(self, name, reject_with=None):
+        super().__init__(name)
+        self.reqs = {}
+        self.answers = {}
+        self.beating = True
+        self.reject_with = reject_with      # raise on submit when set
+        self.reject_count = 0
+        self.cancelled = []
+        self.retracted = []
+        self.probes = []
+        self.imported = []
+        self.export = None
+
+    def submit(self, cases, rid, *, priority=0, deadline_epoch=None,
+               payload=None):
+        if self.reject_with is not None:
+            self.reject_count += 1
+            raise QueueFullError("stub full",
+                                 retry_after_s=self.reject_with)
+        self.reqs[rid] = cases
+
+    def poll(self, rid):
+        return self.answers.get(rid)
+
+    def heartbeat(self):
+        if not self.beating:
+            return None
+        hb = {"t": time.time(), "name": self.name}
+        if self.probes:
+            hb["probe_nonce"] = self.probes[-1]
+        return hb
+
+    def probe(self, nonce):
+        self.probes.append(nonce)
+
+    def cancel(self, rid):
+        self.cancelled.append(rid)
+
+    def retract(self, rid):
+        self.retracted.append(rid)
+        self.reqs.pop(rid, None)
+
+    def read_memory_export(self):
+        return self.export
+
+    def import_memory(self, blob):
+        self.imported.append(blob)
+
+
+def _router(reps, **kw):
+    kw.setdefault("heartbeat_timeout_s", 0.4)
+    kw.setdefault("tick_s", 0.02)
+    kw.setdefault("startup_grace_s", 5.0)
+    return FleetRouter(reps, **kw).start()
+
+
+def _wait(pred, timeout=10.0, msg="condition not reached"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(msg)
+
+
+CASES = None
+
+
+def _stub_cases():
+    # one shared case dict: fingerprinting only reads it, and building
+    # synthetic frames per test is the slow part
+    global CASES
+    if CASES is None:
+        CASES = _cases()
+    return CASES
+
+
+class TestRouterRouting:
+    def test_affinity_sticks_and_counts(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _router([a, b])
+        try:
+            r.submit(_stub_cases(), request_id="x1")
+            first = "a" if "x1" in a.reqs else "b"
+            r.submit(_stub_cases(), request_id="x2")
+            # same structure fingerprint -> same replica, even though
+            # the other one is now less loaded
+            assert ("x2" in (a if first == "a" else b).reqs)
+            m = r.metrics()["routing"]
+            assert m["affinity_hits"] == 1
+            assert m["affinity_misses"] == 1
+        finally:
+            r.close(terminate_replicas=False)
+
+    def test_least_loaded_fallback_when_affinity_full(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _router([a, b], max_inflight_per_replica=1)
+        try:
+            r.submit(_stub_cases(), request_id="x1")
+            loaded = a if "x1" in a.reqs else b
+            other = b if loaded is a else a
+            # affinity replica at its inflight bound -> least-loaded
+            r.submit(_stub_cases(), request_id="x2")
+            assert "x2" in other.reqs
+        finally:
+            r.close(terminate_replicas=False)
+
+    def test_queue_full_redirects_to_next_replica(self):
+        a = StubReplica("a", reject_with=3.0)
+        b = StubReplica("b")
+        r = _router([a, b])
+        try:
+            r.submit(_stub_cases(), request_id="x1")
+            assert "x1" in b.reqs
+            assert r.metrics()["routing"]["redirects"] >= 1
+        finally:
+            r.close(terminate_replicas=False)
+
+    def test_all_full_propagates_min_retry_hint(self):
+        a = StubReplica("a", reject_with=7.0)
+        b = StubReplica("b", reject_with=3.0)
+        r = _router([a, b])
+        try:
+            with pytest.raises(FleetUnavailableError) as ei:
+                r.submit(_stub_cases(), request_id="x1")
+            # the hint survives the routing hop: the SMALLEST per-
+            # replica drain-rate hint, and the typed error is still a
+            # QueueFullError so client backoff discipline applies
+            assert ei.value.retry_after_s == 3.0
+            assert isinstance(ei.value, QueueFullError)
+        finally:
+            r.close(terminate_replicas=False)
+
+    def test_client_retry_discipline_through_router(self):
+        a = StubReplica("a", reject_with=0.02)
+        b = StubReplica("b", reject_with=0.02)
+        r = _router([a, b])
+        try:
+            client = ScenarioClient(r, max_retries=8, jitter_seed=7)
+
+            def release():
+                time.sleep(0.03)
+                a.reject_with = None
+
+            threading.Thread(target=release).start()
+            fut = client.submit(_stub_cases(), request_id="x1")
+            assert "x1" in a.reqs and fut is not None
+            # and the backoff the client slept was the router's hint,
+            # capped + jittered within +/-25%
+            hint = 0.02
+            w = ScenarioClient(r, jitter_seed=7)._backoff_s(hint)
+            assert 0.75 * hint <= w <= 1.25 * hint
+            # seeded determinism
+            assert w == ScenarioClient(r, jitter_seed=7)._backoff_s(hint)
+        finally:
+            r.close(terminate_replicas=False)
+
+    def test_rid_reuse_rejected(self):
+        a = StubReplica("a")
+        r = _router([a])
+        try:
+            r.submit(_stub_cases(), request_id="x1")
+            a.answers["x1"] = ("done", object())
+            with pytest.raises(ValueError, match="already routed"):
+                r.submit(_stub_cases(), request_id="x1")
+        finally:
+            r.close(terminate_replicas=False)
+
+    def test_no_healthy_replica_is_typed(self):
+        a = StubReplica("a")
+        a.state = "dead"
+        a.beating = False       # a beating "dead" replica resurrects
+        r = _router([a])
+        try:
+            with pytest.raises(FleetUnavailableError):
+                r.submit(_stub_cases(), request_id="x1")
+        finally:
+            r.close(terminate_replicas=False)
+
+
+class TestRouterFailover:
+    def test_heartbeat_death_reroutes_exactly_once(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _router([a, b])
+        try:
+            fut = r.submit(_stub_cases(), request_id="x1")
+            victim = a if "x1" in a.reqs else b
+            other = b if victim is a else a
+            victim.export = b"fake-memory-blob"
+            victim.beating = False
+            _wait(lambda: "x1" in other.reqs, msg="not rerouted")
+            # fencing + memory handoff happened
+            assert "x1" in victim.retracted
+            assert other.imported == [b"fake-memory-blob"]
+            other.answers["x1"] = ("done", object())
+            res = fut.result(timeout=5)
+            assert res.recovered and res.replica == other.name
+            m = r.metrics()
+            assert m["routing"]["failovers"] == 1
+            assert m["routing"]["rerouted"] == 1
+            assert m["routing"]["memory_handoffs"] == 1
+            assert m["replicas"][victim.name]["state"] == "dead"
+            assert m["replicas"][victim.name]["breaker"]["state"] == \
+                "open"
+            assert m["failover_latency_s"]["n"] == 1
+        finally:
+            r.close(terminate_replicas=False)
+
+    def test_dead_replicas_completed_answer_is_harvested(self):
+        """Kill between answering and the router noticing: the journal/
+        spool already holds the result — harvest it, never re-solve."""
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _router([a, b])
+        try:
+            fut = r.submit(_stub_cases(), request_id="x1")
+            victim = a if "x1" in a.reqs else b
+            answer = object()
+            victim.answers["x1"] = ("done", answer)
+            victim.beating = False
+            res = fut.result(timeout=5)
+            assert res.result is answer
+            m = r.metrics()["routing"]
+            # harvested (if death won the race) or plainly completed (if
+            # the poller read the answer first) — never both, never zero
+            assert m["completed"] == 1
+            assert m["rerouted"] == 0
+        finally:
+            r.close(terminate_replicas=False)
+
+    def test_watchdog_reroutes_wedged_request(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _router([a, b], request_timeout_s=0.2)
+        try:
+            fut = r.submit(_stub_cases(), request_id="x1")
+            primary = a if "x1" in a.reqs else b
+            other = b if primary is a else a
+            # primary heartbeats happily but never answers: only the
+            # per-request watchdog can see this
+            _wait(lambda: "x1" in other.reqs, msg="watchdog never fired")
+            assert primary.beating
+            other.answers["x1"] = ("done", object())
+            res = fut.result(timeout=5)
+            assert res.replica == other.name
+            m = r.metrics()
+            assert m["routing"]["watchdog_reroutes"] == 1
+            # the wedged replica took a breaker failure sample
+            assert m["replicas"][primary.name]["breaker"]["samples"] >= 1
+        finally:
+            r.close(terminate_replicas=False)
+
+    def test_late_duplicate_suppressed_first_answer_wins(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _router([a, b], request_timeout_s=0.2)
+        try:
+            fut = r.submit(_stub_cases(), request_id="x1")
+            primary = a if "x1" in a.reqs else b
+            other = b if primary is a else a
+            _wait(lambda: "x1" in other.reqs, msg="watchdog never fired")
+            first = object()
+            other.answers["x1"] = ("done", first)
+            res = fut.result(timeout=5)
+            assert res.result is first
+            # the wedged primary finally answers: suppressed, counted
+            primary.answers["x1"] = ("done", object())
+            _wait(lambda: r.metrics()["routing"][
+                "duplicates_suppressed"] == 1,
+                msg="late duplicate not counted")
+            assert fut.result(timeout=0).result is first
+        finally:
+            r.close(terminate_replicas=False)
+
+    def test_probe_closes_breaker_after_flap(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _router([a, b], request_timeout_s=0.1,
+                    breaker_opts={"min_samples": 1,
+                                  "failure_threshold": 0.5,
+                                  "cooldown_s": 0.2})
+        try:
+            fut = r.submit(_stub_cases(), request_id="x1")
+            primary = a if "x1" in a.reqs else b
+            other = b if primary is a else a
+            _wait(lambda: "x1" in other.reqs, msg="watchdog never fired")
+            other.answers["x1"] = ("done", object())
+            fut.result(timeout=5)
+            # the flapping replica's breaker opened on the watchdog
+            # failure; it keeps heartbeating, so after the cooldown the
+            # router probes it (nonce echo, no solve) and closes
+            br = r.breakers.get(primary.name)
+            _wait(lambda: br.state == CircuitBreaker.CLOSED,
+                  msg="probe never closed the breaker")
+            assert primary.probes, "no probe nonce was sent"
+            assert r.metrics()["routing"]["probes_ok"] >= 1
+        finally:
+            r.close(terminate_replicas=False)
+
+
+class TestRouterHedging:
+    def test_deadline_pressure_hedges_first_answer_wins(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _router([a, b], hedge_min_wait_s=0.1, hedge_wait_frac=0.01)
+        try:
+            fut = r.submit(_stub_cases(), request_id="x1",
+                           deadline_s=30.0)
+            primary = a if "x1" in a.reqs else b
+            other = b if primary is a else a
+            _wait(lambda: "x1" in other.reqs, msg="hedge never fired")
+            m = r.metrics()["routing"]
+            assert m["hedged"] == 1
+            # hedge answers first -> it wins, the loser gets a cancel
+            other.answers["x1"] = ("done", object())
+            res = fut.result(timeout=5)
+            assert res.hedged and res.replica == other.name
+            _wait(lambda: "x1" in primary.cancelled,
+                  msg="loser never cancelled")
+            assert r.metrics()["routing"]["hedge_wins"] == 1
+            # loser answers anyway at its round boundary: suppressed
+            primary.answers["x1"] = ("done", object())
+            _wait(lambda: r.metrics()["routing"][
+                "duplicates_suppressed"] == 1,
+                msg="hedge loser's answer not suppressed")
+        finally:
+            r.close(terminate_replicas=False)
+
+    def test_no_hedge_without_deadline(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _router([a, b], hedge_min_wait_s=0.05, hedge_wait_frac=0.01)
+        try:
+            r.submit(_stub_cases(), request_id="x1")
+            time.sleep(0.3)
+            assert r.metrics()["routing"]["hedged"] == 0
+            assert len(a.reqs) + len(b.reqs) == 1
+        finally:
+            r.close(terminate_replicas=False)
+
+
+# ---------------------------------------------------------------------------
+# Local-replica (real ScenarioService) end-to-end
+# ---------------------------------------------------------------------------
+
+class TestLocalFleet:
+    def _fleet(self, n=2, **router_kw):
+        services = [ScenarioService(backend="cpu", max_wait_s=0.0)
+                    for _ in range(n)]
+        for s in services:
+            s.start()
+        reps = [LocalReplica(f"n{i}", s)
+                for i, s in enumerate(services)]
+        router = _router(reps, heartbeat_timeout_s=1.0, **router_kw)
+        return router, reps, services
+
+    def test_routed_solve_end_to_end(self):
+        router, reps, services = self._fleet()
+        try:
+            fut = router.submit(_cases(), request_id="e1")
+            res = fut.result(timeout=300)
+            assert res.result is not None
+            cert = res.load_run_health()["certification"]
+            assert cert["enabled"] and cert["windows_certified"] > 0
+            assert res.latency_s > 0
+        finally:
+            router.close(terminate_replicas=False)
+            for s in services:
+                s.close()
+
+    def test_kill_mid_flight_recovers_on_sibling(self):
+        router, reps, services = self._fleet()
+        try:
+            fut = router.submit(_cases(), request_id="e1")
+            victim = next(rep for rep in reps if "e1" in rep._futures)
+            # the service keeps solving (a hung-not-dead replica), but
+            # its heartbeats stop: the router must not wait for it
+            victim.kill()
+            res = fut.result(timeout=300)
+            assert res.result is not None
+            m = router.metrics()["routing"]
+            assert m["completed"] == 1
+            # either the sibling solved it (reroute) or the victim's
+            # answer landed before death was declared (harvest-or-
+            # normal) — exactly one delivery either way
+            assert m["failovers"] >= 1
+        finally:
+            router.close(terminate_replicas=False)
+            for s in services:
+                s.close()
+
+    def test_overload_redirect_with_real_services(self):
+        router, reps, services = self._fleet()
+        try:
+            # the first admission is rejected by the overload fault
+            # (queue-full shape, real drain-rate hint); the router
+            # redirects to the sibling and the request still completes
+            with faultinject.inject(overload=True, overload_n=1):
+                fut = router.submit(_cases(), request_id="e1")
+            res = fut.result(timeout=300)
+            assert res.result is not None
+            assert router.metrics()["routing"]["redirects"] == 1
+        finally:
+            router.close(terminate_replicas=False)
+            for s in services:
+                s.close()
+
+
+# ---------------------------------------------------------------------------
+# Subprocess spool replicas: the replica_crash fault drill
+# ---------------------------------------------------------------------------
+
+class TestSpoolFleet:
+    def test_replica_crash_failover_exactly_once(self, tmp_path):
+        """A real serve process hard-exits (os._exit — the SIGKILL
+        analogue) right after journaling its first admission.  The
+        router must detect the death, replay the journal, re-route the
+        orphaned request to the healthy replica, and deliver exactly
+        one certified answer."""
+        from dervet_tpu.service import spawn_replica
+        logs = [open(tmp_path / f"r{i}.log", "w") for i in range(2)]
+        victim = spawn_replica(
+            tmp_path / "victim", name="victim", backend="cpu",
+            stdout=logs[0], stderr=logs[0],
+            env={"DERVET_TPU_FAULT_REPLICA_CRASH": "1"})
+        healthy = spawn_replica(
+            tmp_path / "healthy", name="healthy", backend="cpu",
+            stdout=logs[1], stderr=logs[1])
+        router = FleetRouter(
+            [victim, healthy], fleet_dir=tmp_path / "fleet",
+            heartbeat_timeout_s=3.0, tick_s=0.05,
+            # force the primary route onto the crashing replica
+            max_inflight_per_replica=32).start()
+        try:
+            # two DISTINCT structures so affinity cannot pile both onto
+            # one replica: least-loaded puts c0 on 'healthy' (name
+            # order), c1 on 'victim' — whose first admission crashes it
+            futs = {
+                "c0": router.submit(_cases(), request_id="c0",
+                                    deadline_s=300.0),
+                "c1": router.submit(_cases(window=96, variant=1),
+                                    request_id="c1", deadline_s=300.0),
+            }
+            results = {rid: fut.result(timeout=280)
+                       for rid, fut in futs.items()}
+            m = router.metrics()
+            r = m["routing"]
+            assert r["completed"] == 2 and r["failed"] == 0
+            assert r["failovers"] == 1, r
+            assert r["harvested"] + r["rerouted"] >= 1, r
+            assert m["replicas"]["victim"]["state"] == "dead"
+            assert m["replicas"]["victim"]["breaker"]["state"] == "open"
+            recovered = [rid for rid, res in results.items()
+                         if res.recovered]
+            assert recovered, "crash produced no recovered request"
+            for rid, res in results.items():
+                cert = res.load_run_health()["certification"]
+                assert cert["enabled"]
+                assert cert["windows"]["rejected_final"] == 0
+            # the victim's journal shows the orphaned admission the
+            # failover recovered
+            states = ServiceJournal.replay_path(
+                tmp_path / "victim" / "service_journal.jsonl")
+            assert any(e["state"] == "admitted"
+                       for e in states.values())
+        finally:
+            router.close()
+            for lg in logs:
+                lg.close()
+
+    @pytest.mark.slow
+    def test_replica_hang_detected_by_missed_heartbeats(self, tmp_path):
+        """The serve scan loop wedges (heartbeats stop, process alive):
+        the router's staleness watchdog must fail over just like a
+        crash."""
+        from dervet_tpu.service import spawn_replica
+        logs = [open(tmp_path / f"r{i}.log", "w") for i in range(2)]
+        hanger = spawn_replica(
+            tmp_path / "hanger", name="hanger", backend="cpu",
+            stdout=logs[0], stderr=logs[0],
+            env={"DERVET_TPU_FAULT_REPLICA_HANG": "1",
+                 "DERVET_TPU_FAULT_REPLICA_HANG_S": "3600"})
+        healthy = spawn_replica(
+            tmp_path / "healthy", name="healthy", backend="cpu",
+            stdout=logs[1], stderr=logs[1])
+        router = FleetRouter(
+            [hanger, healthy], fleet_dir=tmp_path / "fleet",
+            heartbeat_timeout_s=2.0, tick_s=0.05).start()
+        try:
+            futs = {f"h{i}": router.submit(_cases(variant=i),
+                                           request_id=f"h{i}",
+                                           deadline_s=300.0)
+                    for i in range(2)}
+            results = {rid: fut.result(timeout=280)
+                       for rid, fut in futs.items()}
+            assert router.metrics()["routing"]["completed"] == 2
+            assert all(res.results_dir is not None
+                       for res in results.values())
+            # the hanger's BATCHER thread may outrace the 2s staleness
+            # window and answer before death is declared (the scan
+            # thread is what wedged) — the drill's claim is that the
+            # wedged replica is EVENTUALLY declared dead and fenced
+            _wait(lambda: router.metrics()["replicas"]["hanger"][
+                "state"] == "dead", timeout=30,
+                msg="hung replica never declared dead")
+            # SIGKILL fencing reaped the hung-but-alive process
+            _wait(lambda: hanger.process.poll() is not None, timeout=30,
+                  msg="hung replica process never fenced")
+        finally:
+            router.close()
+            for lg in logs:
+                lg.close()
