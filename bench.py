@@ -253,6 +253,11 @@ def main() -> None:
             legs["warm_start"] = warm_start_leg()
         except Exception as e:          # noqa: BLE001
             legs["warm_start"] = {"error": str(e)[:300]}
+    if int(os.environ.get("BENCH_SOLVER_CORE", "1")):
+        try:
+            legs["solver_core"] = solver_core_leg()
+        except Exception as e:          # noqa: BLE001
+            legs["solver_core"] = {"error": str(e)[:300]}
     if int(os.environ.get("BENCH_CHAOS", "1")):
         try:
             legs["serving_chaos"] = serving_chaos_leg()
@@ -847,6 +852,160 @@ def serving_elastic_leg() -> dict:
         "serial_scheduler_within_tolerance": serial_close,
         "gates": gates,
         "gated_on_real_mesh": real_mesh,
+    }
+
+
+def solver_core_leg() -> dict:
+    """Solver-core proof (ops/pdhg.py variants + ops/seedpredict.py):
+    the iteration COUNT is the product-path ceiling (BENCH_r05: iters
+    p50 1664 at 0.26% FLOPs utilization), and the step variants + the
+    learned cold-start predictor attack it directly.
+
+    Four cold passes over one sensitivity-fanout batch (a monthly
+    dispatch window structure x BENCH_CORE_BATCH perturbed-price
+    instances): vanilla, reflected, halpern, and halpern seeded by the
+    learned predictor (trained on a DISJOINT batch of the same
+    structure — the structure-repeat cold shape).  Published under
+    ``legs.solver_core`` with iters p50/p99 and wall per pass, plus the
+    chunk-kernel selection per pass (the kernel gate fails the leg on a
+    runtime_disabled fallback exactly like the dispatch legs).
+
+    Gates: the default variant alone >= 30% median cold-iteration
+    reduction vs vanilla; halpern+predicted >= 2x vs vanilla cold; all
+    passes 100% converged."""
+    import numpy as _np
+
+    from dervet_tpu.benchlib import build_window_lps, synthetic_case
+    from dervet_tpu.ops import warmstart
+    from dervet_tpu.ops.pdhg import (CompiledLPSolver, PDHGOptions,
+                                     kernel_selection)
+
+    batch = int(os.environ.get("BENCH_CORE_BATCH", "16"))
+    case = synthetic_case()
+    _, groups = build_window_lps(case)
+    lp0 = sorted(groups.items())[0][1][0]
+    rng = _np.random.default_rng(7)
+
+    # structure-repeat cold traffic: per-instance price-LEVEL shift
+    # (±15%) over a stable hourly shape plus idiosyncratic per-hour
+    # noise.  At resubmission-grade noise (0.3% — well past the float16
+    # quant digest, so these are genuinely cold: no near grade fires)
+    # the systematic component dominates and a learned seed recovers
+    # most of the iterate; at 1% per-hour noise the optimal dispatch
+    # basis itself shifts instance-to-instance, which NO seed-based
+    # method can predict — that row is reported (noise_sensitivity) but
+    # not gated.
+    def fanout(n, noise=0.003):
+        s = rng.uniform(0.85, 1.15, n)
+        return _np.stack([lp0.c * s[i] * (1 + noise * rng.standard_normal(
+            lp0.c.shape)) for i in range(n)])
+
+    C = fanout(batch)
+
+    def run(opts, x0=None, y0=None):
+        solver = CompiledLPSolver(lp0, opts)
+        t0 = time.time()
+        res = solver.solve(c=C, x0=x0, y0=y0)
+        it = _np.asarray(res.iters)
+        conv = int(_np.asarray(res.converged).sum())
+        kern, kern_why = kernel_selection(solver, batched=True)
+        if conv != batch:
+            raise AssertionError(
+                f"solver_core: {conv}/{batch} converged under "
+                f"{opts.variant}")
+        return {"iters_p50": int(_np.percentile(it, 50)),
+                "iters_p99": int(_np.percentile(it, 99)),
+                "wall_s": round(time.time() - t0, 2),
+                "restarts": int(_np.asarray(res.restarts).sum()),
+                "kernel": kern,
+                **({"kernel_fallback": kern_why} if kern_why else {})}
+
+    passes = {
+        "vanilla": run(PDHGOptions(variant="vanilla")),
+        "reflected": run(PDHGOptions(variant="reflected")),
+        "halpern": run(PDHGOptions(variant="halpern")),
+    }
+
+    # halpern+predicted: train the memory/predictor on a disjoint batch
+    # of the same structure, then serve predictions for the bench batch
+    train_opts = PDHGOptions(variant="halpern")
+    trainer = CompiledLPSolver(lp0, train_opts)
+    mem = warmstart.SolutionMemory(max_entries=64)
+    tag = warmstart.opts_tag(train_opts)
+    Ct = fanout(8)
+    rt = trainer.solve(c=Ct)
+    import copy as _copy
+
+    def _mk_lp(c_row):
+        lpi = _copy.copy(lp0)
+        lpi.c = c_row
+        return lpi
+
+    for i in range(Ct.shape[0]):
+        mem.store("bench-core", _mk_lp(Ct[i]), tag, _np.asarray(rt.x)[i],
+                  _np.asarray(rt.y)[i], float(_np.asarray(rt.obj)[i]))
+    plans = warmstart.plan_group(mem, "bench-core",
+                                 [_mk_lp(C[i]) for i in range(batch)],
+                                 train_opts, list(range(batch)))
+    n_pred = sum(1 for p in plans if p.kind == "predicted")
+    X0 = _np.stack([p.entry.x if p.entry is not None
+                    else _np.zeros(lp0.n) for p in plans])
+    Y0 = _np.stack([p.entry.y if p.entry is not None
+                    else _np.zeros(lp0.m) for p in plans])
+    passes["halpern_predicted"] = {**run(train_opts, x0=X0, y0=Y0),
+                                   "predicted": n_pred}
+
+    # ungated sensitivity row: the same predicted-seed recipe against a
+    # 1% per-hour-noise fanout, quantifying how the win degrades as the
+    # idiosyncratic (basis-shifting) component grows
+    Cn = fanout(batch, noise=0.01)
+    plans_n = warmstart.plan_group(
+        mem, "bench-core", [_mk_lp(Cn[i]) for i in range(batch)],
+        train_opts, list(range(batch)))
+    Xn = _np.stack([p.entry.x if p.entry is not None
+                    else _np.zeros(lp0.n) for p in plans_n])
+    Yn = _np.stack([p.entry.y if p.entry is not None
+                    else _np.zeros(lp0.m) for p in plans_n])
+    noise_solver = CompiledLPSolver(lp0, train_opts)
+    res_n = noise_solver.solve(c=Cn, x0=Xn, y0=Yn)
+    res_v = CompiledLPSolver(
+        lp0, PDHGOptions(variant="vanilla")).solve(c=Cn)
+    noise_sens = {
+        "noise": 0.01,
+        "iters_p50_vanilla_cold": int(_np.percentile(
+            _np.asarray(res_v.iters), 50)),
+        "iters_p50_halpern_predicted": int(_np.percentile(
+            _np.asarray(res_n.iters), 50)),
+    }
+
+    # the kernel gate, wired exactly like the dispatch legs: a
+    # runtime_disabled fallback on any pass is a regression
+    from collections import Counter
+    reasons = Counter(p["kernel_fallback"] for p in passes.values()
+                      if p.get("kernel_fallback"))
+    check_kernel_gate({"kernel": {"fallback_reasons": dict(reasons)}},
+                      "solver_core")
+
+    van = passes["vanilla"]["iters_p50"]
+    variant_red = 1.0 - passes["reflected"]["iters_p50"] / van
+    pred_speedup = van / max(passes["halpern_predicted"]["iters_p50"], 1)
+    ok = variant_red >= 0.30 and pred_speedup >= 2.0 and n_pred == batch
+    log(f"bench[solver_core]: iters p50 vanilla {van} -> reflected "
+        f"{passes['reflected']['iters_p50']} "
+        f"({100 * variant_red:.0f}% reduction) -> halpern "
+        f"{passes['halpern']['iters_p50']} -> halpern+predicted "
+        f"{passes['halpern_predicted']['iters_p50']} "
+        f"({pred_speedup:.1f}x, {n_pred}/{batch} predicted); "
+        f"gate: {'OK' if ok else 'FAIL'}")
+    if not ok:
+        raise SystemExit(10)    # 8/9 are the warm-start/kernel codes
+    return {
+        "batch": batch, "m": lp0.m, "n": lp0.n,
+        "passes": passes,
+        "variant_reduction": round(variant_red, 4),
+        "predicted_speedup": round(pred_speedup, 2),
+        "predicted_fraction": round(n_pred / batch, 3),
+        "noise_sensitivity": noise_sens,
     }
 
 

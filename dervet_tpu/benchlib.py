@@ -159,7 +159,10 @@ LEDGER_JAX_GROUP_KEYS = (
     "m", "n", "sharded", "staged", "stack_s", "iters_p50", "iters_p99",
     "iters_max", "dispatches", "chunks", "compile_events", "h2d_bytes",
     "h2d_s", "readbacks", "sync_wait_s", "result_fetch_s",
-    "bucket_occupancy", "other_s")
+    "bucket_occupancy", "other_s",
+    # solver-core observables (PR 11): step variant, adaptive-restart
+    # count, realized check cadence
+    "variant", "restarts", "cadence_final")
 
 
 def validate_solve_ledger(ledger: Dict) -> Dict:
@@ -193,6 +196,15 @@ def validate_solve_ledger(ledger: Dict) -> Dict:
     af = ledger["accounted_fraction"]
     if af is not None and not 0.0 <= af <= 2.0:
         raise ValueError(f"accounted_fraction out of range: {af}")
+    # any variant-carrying group must be aggregated into solver_core
+    if any(g.get("variant") for g in ledger["groups"]):
+        core = ledger.get("solver_core")
+        if not isinstance(core, dict):
+            raise ValueError("solve_ledger missing 'solver_core' despite "
+                             "variant-carrying groups")
+        for k in ("variants", "restarts", "anchor_resets"):
+            if k not in core:
+                raise ValueError(f"solve_ledger.solver_core missing {k!r}")
     return ledger
 
 

@@ -350,7 +350,7 @@ def run_design(case, spec: DesignSpec, *, backend: str = "jax",
         screen_opts_override=screen_opts_override, caches=caches,
         refine_rounds=spec.refine_rounds, refine_keep=spec.refine_keep,
         top_k=spec.top_k, budget=spec.budget, supervisor=supervisor,
-        request_id=request_id)
+        request_id=request_id, screen_variant=spec.screen_variant)
     finalists = report.top(spec.top_k)
     if not finalists:
         reasons = sorted({e.reason for e in report.entries if e.reason})

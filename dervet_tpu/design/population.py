@@ -77,11 +77,24 @@ class DesignSpec:
     # tier, ``refine_rounds`` times, before the top-k are certified
     refine_rounds: int = 1
     refine_keep: float = 0.25
+    # solver step variant for the SCREENING tiers only (ops/pdhg.py
+    # PDHG_VARIANTS): screening solves are hard-budget truncated, so a
+    # faster-converging variant buys ranking fidelity at the same
+    # candidate cost.  None inherits the base solver options (the
+    # certified finalist tier always uses those unchanged).
+    screen_variant: Optional[str] = None
 
     def validate(self) -> "DesignSpec":
         if not self.bounds and not self.grid:
             raise ParameterError("design spec: no size bounds and no "
                                  "explicit grid — nothing to design")
+        if self.screen_variant is not None:
+            from ..ops.pdhg import PDHG_VARIANTS
+            if self.screen_variant not in PDHG_VARIANTS:
+                raise ParameterError(
+                    f"design spec: screen_variant "
+                    f"{self.screen_variant!r} is not one of "
+                    f"{PDHG_VARIANTS}")
         for (tag, der_id), b in self.bounds.items():
             if b.kw is None and b.kwh is None:
                 raise ParameterError(

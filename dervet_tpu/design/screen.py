@@ -50,14 +50,24 @@ SCREEN_TIERS = (
 )
 
 
-def screening_options(base: Optional[PDHGOptions], tier: int
-                      ) -> PDHGOptions:
+def screening_options(base: Optional[PDHGOptions], tier: int,
+                      variant: Optional[str] = None) -> PDHGOptions:
     """The screening-tier solver options for refinement round ``tier``
-    (clamped to the tightest tier)."""
+    (clamped to the tightest tier).
+
+    ``variant`` overrides the solver step variant for the screening
+    tiers only (see ``ops.pdhg.PDHG_VARIANTS``): a screening solve is a
+    HARD-BUDGET truncated solve whose ranking fidelity is set by how far
+    the budget gets, so a faster-converging variant buys rank quality at
+    the same candidate cost.  None inherits ``base`` (the service
+    default); the ``DERVET_TPU_PDHG_VARIANT`` kill switch still wins at
+    jit-build time."""
     t = SCREEN_TIERS[min(tier, len(SCREEN_TIERS) - 1)]
     opts = PDHGOptions.screening(base, max_iters=t["max_iters"])
-    return dataclasses.replace(opts, eps_rel=t["eps_rel"],
-                               eps_abs=t["eps_abs"])
+    rep = {"eps_rel": t["eps_rel"], "eps_abs": t["eps_abs"]}
+    if variant is not None:
+        rep["variant"] = variant
+    return dataclasses.replace(opts, **rep)
 
 
 class ScreeningCaches:
@@ -252,6 +262,7 @@ def screen_candidates(case, candidates: List[Candidate], *,
                       refine_rounds: int = 1, refine_keep: float = 0.25,
                       top_k: int = 8, budget: Optional[float] = None,
                       supervisor=None, request_id: Optional[str] = None,
+                      screen_variant: Optional[str] = None,
                       ) -> ScreenReport:
     """Screen ``candidates`` and rank them.
 
@@ -301,7 +312,8 @@ def screen_candidates(case, candidates: List[Candidate], *,
         if not active:
             break
         opts = (screen_opts_override if screen_opts_override is not None
-                else screening_options(base_opts, rnd))
+                else screening_options(base_opts, rnd,
+                                       variant=screen_variant))
         round_scens = [scens[i] for i in active]
         t_round = time.perf_counter()
         # ordinal tier: certification OFF, scoped to THIS thread only —

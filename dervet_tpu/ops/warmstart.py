@@ -52,6 +52,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from . import seedpredict
+
 WARMSTART_ENV = "DERVET_TPU_WARMSTART"
 CAP_ENV = "DERVET_TPU_WARMSTART_CAP"
 DEFAULT_CAP = 512
@@ -206,7 +208,7 @@ class SeedEntry:
 @dataclasses.dataclass
 class MemberPlan:
     """One group member's warm-start decision."""
-    kind: str                        # "cold" | "near" | "exact"
+    kind: str              # "cold" | "predicted" | "near" | "exact"
     entry: Optional[SeedEntry] = None
     substituted: bool = False        # exact hit that passed the f64 check
     stale_fault: bool = False        # seed corrupted by fault injection
@@ -240,12 +242,16 @@ class SolutionMemory:
         self._by_quant: Dict[tuple, tuple] = {}
         self._cold_iters: Dict[object, deque] = {}
         self.stats = {"stores": 0, "evictions": 0, "hits_exact": 0,
-                      "hits_near": 0, "misses": 0, "substituted": 0,
-                      "stale_seed_faults": 0, "invalidated": 0,
-                      "imported": 0}
+                      "hits_near": 0, "hits_predicted": 0, "misses": 0,
+                      "substituted": 0, "stale_seed_faults": 0,
+                      "invalidated": 0, "imported": 0}
         # keys imported from another replica's export (fleet failover):
         # these serve the EXACT path only — see import_entries
         self._imported_keys: set = set()
+        # learned cold-start predictor (ops/seedpredict.py): this
+        # memory's entries double as its training set, and it rides the
+        # memory's invalidation + fleet-handoff plumbing
+        self.predictor = seedpredict.SeedPredictor()
 
     # -- internals (caller holds the lock) ------------------------------
     def _unlink(self, key, entry) -> None:
@@ -282,14 +288,21 @@ class SolutionMemory:
         quantized digest or the nearest feature vector, ``(None, None)``
         when this structure has no entries."""
         entry, kind, _, _ = self.probe(skey, lp, tag)
-        return entry, kind
+        return entry, ("near" if kind == "feature" else kind)
 
     def probe(self, skey, lp, tag: tuple):
         """`lookup` plus the member's own ``(exact, quant)`` digests, so
         a later ``store`` of this member's solution skips recomputing
         the sha256 passes (~ms each at year-LP sizes).  The exact
         digest is taken at the tag's solver dtype — the resolution the
-        device actually solves at."""
+        device actually solves at.
+
+        The two near sub-grades are distinguished here: ``"near"`` is a
+        quantized-digest hit (the stored data agrees with this member's
+        to ~3 significant digits — a genuinely nearby iterate), while
+        ``"feature"`` is the nearest-by-feature fallback (same
+        structure, arbitrarily far data) — the grade the learned
+        predictor outranks in :func:`plan_group`."""
         exact = data_digest(lp, tag_dtype(tag))
         quant = quant_digest(lp)
         with self._lock:
@@ -314,7 +327,7 @@ class SolutionMemory:
                         np.linalg.norm(pool[k].feature - f)))
                 self._entries.move_to_end(best_key)
                 self.stats["hits_near"] += 1
-                return pool[best_key], "near", exact, quant
+                return pool[best_key], "feature", exact, quant
             self.stats["misses"] += 1
             return None, None, exact, quant
 
@@ -347,8 +360,10 @@ class SolutionMemory:
         solution the memory vouched for — without this, a
         wrong-but-convergence-passing entry would be re-substituted,
         re-rejected, and re-escalated on every exact repeat forever
-        (each hit even refreshing it against LRU eviction).  Returns the
-        number of entries dropped."""
+        (each hit even refreshing it against LRU eviction).  The
+        structure's learned seed model is dropped too: its training set
+        just proved untrustworthy here.  Returns the number of entries
+        dropped."""
         exact = data_digest(lp, dtype)
         with self._lock:
             doomed = [k for k in self._entries
@@ -356,7 +371,16 @@ class SolutionMemory:
             for key in doomed:
                 self._unlink(key, self._entries.pop(key))
             self.stats["invalidated"] += len(doomed)
-            return len(doomed)
+        self.predictor.invalidate(skey)
+        return len(doomed)
+
+    def entries_for_structure(self, skey) -> List[SeedEntry]:
+        """Live entries for one structure, oldest-first — the learned
+        predictor's training set (a locked snapshot of references; the
+        entries themselves are never mutated in place)."""
+        with self._lock:
+            pool = self._by_struct.get(skey)
+            return list(pool.values()) if pool else []
 
     # -- fleet failover handoff -----------------------------------------
     def export_entries(self, max_entries: int = 128) -> List[Tuple]:
@@ -414,6 +438,24 @@ class SolutionMemory:
                 self._evict_lru()
         return n
 
+    def export_payload(self, max_entries: int = 128,
+                       max_models: int = 16) -> Dict:
+        """The full fleet-handoff payload: recent entries PLUS the
+        learned seed models (ops/seedpredict.py), so the inheriting
+        replica both substitutes byte-exact repeats and predicts for
+        structures it never solved."""
+        return {"entries": self.export_entries(max_entries),
+                "models": self.predictor.export_models(max_models)}
+
+    def import_payload(self, payload, exact_only: bool = True) -> int:
+        """Install an exported payload — the ``export_payload`` dict or
+        a bare ``export_entries`` list (older replicas).  Returns the
+        number of ENTRIES installed (models are best-effort extras)."""
+        if isinstance(payload, dict):
+            self.predictor.import_models(payload.get("models"))
+            payload = payload.get("entries") or []
+        return self.import_entries(payload, exact_only=exact_only)
+
     def note_cold_iters(self, skey, iters) -> None:
         """Record cold members' iteration counts — the per-structure
         baseline ``iters_saved`` is measured against."""
@@ -428,31 +470,65 @@ class SolutionMemory:
 
     def snapshot(self) -> Dict:
         with self._lock:
-            return {"entries": len(self._entries),
+            snap = {"entries": len(self._entries),
                     "structures": len(self._by_struct),
                     "imported_live": len(self._imported_keys),
                     "max_entries": self.max_entries,
                     "bytes": int(sum(e.x.nbytes + e.y.nbytes
                                      for e in self._entries.values())),
                     **dict(self.stats)}
+        snap["predictor"] = self.predictor.snapshot()
+        return snap
 
 
 def plan_group(memory: SolutionMemory, skey, lps, opts, labels
                ) -> List[MemberPlan]:
     """Per-member warm-start plan for one structure group.
 
+    Grade ladder per member: **exact** (byte-identical data + tag, may
+    substitute), **near** (quantized-digest hit — a stored iterate whose
+    data agrees to ~3 significant digits), **predicted** (the learned
+    seed model's interpolation — outranks the nearest-by-feature
+    fallback, whose entry may be arbitrarily far, but never a genuine
+    near hit), feature-nearest (reported as ``near``), cold.
+
     Exact hits are promoted to substitution only after the stored
     solution passes :func:`check_converged_host` under the CURRENT
     options; the ``stale_seed`` fault corrupts a targeted member's seed
-    COPY and demotes it to iterate seeding — the production shape of a
-    stale/evicted/poisoned entry, which may cost iterations but is
+    COPY — stored entry or fresh prediction alike — and demotes it to
+    plain iterate seeding: the production shape of a stale, evicted,
+    poisoned, or mis-predicted seed, which may cost iterations but is
     always caught by the normal convergence criteria."""
     from ..utils import faultinject
     tag = opts_tag(opts)
     plans: List[MemberPlan] = []
     fplan = faultinject.get_plan()
+    predictor = memory.predictor
+    use_pred = seedpredict.enabled()
+    if use_pred:
+        # opportunistic (re)fit from this structure's live entries —
+        # host-side, feature-dimension-sized, microseconds
+        predictor.maybe_fit(skey, memory.entries_for_structure(skey))
     for lp, label in zip(lps, labels):
         entry, kind, exact, quant = memory.probe(skey, lp, tag)
+        if use_pred and kind in (None, "feature"):
+            pred = predictor.predict(skey, feature_vec(lp))
+            if pred is not None:
+                entry = SeedEntry(
+                    x=np.asarray(pred[0], tag_dtype(tag)),
+                    y=np.asarray(pred[1], tag_dtype(tag)),
+                    obj=float("nan"), feature=np.zeros(0), tag=tag,
+                    exact=b"", quant=b"")
+                # RECLASSIFY the probe's counter: the member is served
+                # by the prediction, not by the feature fallback / miss
+                # the probe just tallied — without this the grade
+                # counters sum to more than the lookups
+                memory.bump("hits_near" if kind == "feature"
+                            else "misses", -1)
+                kind = "predicted"
+                memory.bump("hits_predicted")
+        if kind == "feature":
+            kind = "near"
         if entry is None:
             plans.append(MemberPlan("cold", exact_digest=exact,
                                     quant_digest=quant))
@@ -466,9 +542,10 @@ def plan_group(memory: SolutionMemory, skey, lps, opts, labels
                               feature=entry.feature, tag=entry.tag,
                               exact=b"", quant=b"")
             memory.bump("stale_seed_faults")
-            plans.append(MemberPlan("near", stale, stale_fault=True,
-                                    exact_digest=exact,
-                                    quant_digest=quant))
+            plans.append(MemberPlan(
+                kind if kind == "predicted" else "near", stale,
+                stale_fault=True, exact_digest=exact,
+                quant_digest=quant))
             continue
         mp = MemberPlan(kind, entry, exact_digest=exact,
                         quant_digest=quant)

@@ -163,7 +163,9 @@ def solve_batch_sharded(solver: CompiledLPSolver, mesh: Mesh,
                                               stats, x0=x0, y0=y0)
         except Exception as e:
             from ..ops import pallas_chunk
+            from ..ops.pdhg import VARIANT_VANILLA
             kernel_in_play = (solver.opts.pallas_chunk
+                              and solver.variant == VARIANT_VANILLA
                               and pallas_chunk.supports(
                                   solver.op, solver.opts.dtype,
                                   solver.opts.precision,
@@ -254,7 +256,8 @@ def _solve_batch_sharded_inner(solver: CompiledLPSolver, mesh: Mesh,
 
     res_specs = PDHGResult(x=P(AXIS), y=P(AXIS), obj=P(AXIS),
                            converged=P(AXIS), iters=P(AXIS),
-                           prim_res=P(AXIS), gap=P(AXIS), status=P(AXIS))
+                           prim_res=P(AXIS), gap=P(AXIS), status=P(AXIS),
+                           restarts=P(AXIS))
     sh_init = jax.jit(shard_map(
         local_init, mesh=mesh, in_specs=(P(AXIS),) * 4, out_specs=P(AXIS)))
     sh_init_seed = jax.jit(shard_map(

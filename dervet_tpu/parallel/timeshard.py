@@ -175,9 +175,11 @@ class TimeShardedLPSolver:
             x=rep, y=row, x_sum=rep, y_sum=row, inner=rep, total=rep,
             omega=rep, x_restart=rep, y_restart=row, mu_restart=rep,
             mu_prev=rep, converged=rep, done_x=rep, done_y=row,
-            iters_at_conv=rep, infeas_streak=rep, infeasible=rep)
+            iters_at_conv=rep, infeas_streak=rep, infeasible=rep,
+            restarts=rep, cadence=rep)
         res_spec = PDHGResult(x=rep, y=row, obj=rep, converged=rep,
-                              iters=rep, prim_res=rep, gap=rep, status=rep)
+                              iters=rep, prim_res=rep, gap=rep, status=rep,
+                              restarts=rep)
         data_specs = (op_spec, rep, row, rep, rep, row, rep)
 
         # every row-space reduction inside is an explicit psum, so outputs
@@ -208,9 +210,9 @@ class TimeShardedLPSolver:
             state = self._chunk(*args, self.eta, state, limit)
             # one fused readback per chunk (remote fetches cost ~100 ms
             # of latency each regardless of size)
-            total, n_active = (int(v) for v in np.asarray(
+            total, n_active, _cad = (int(v) for v in np.asarray(
                 _status_scalars(state.total, state.converged,
-                                state.infeasible)))
+                                state.infeasible, state.cadence)))
             if n_active == 0 or total >= opts.max_iters:
                 break
         res = self._fin(*args, state)
@@ -218,4 +220,4 @@ class TimeShardedLPSolver:
         return PDHGResult(x=res.x, y=res.y[:self.lp.m], obj=res.obj,
                           converged=res.converged, iters=res.iters,
                           prim_res=res.prim_res, gap=res.gap,
-                          status=res.status)
+                          status=res.status, restarts=res.restarts)

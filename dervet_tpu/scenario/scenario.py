@@ -1484,8 +1484,8 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
         # one fetch per field — seven ~100 ms round trips per group
         # become one on remote backends.
         fetched = fetch_result_host(res, stats, want_y=want_y)
-        x_h, obj_h, conv_h, iters_h, pr_h, gap_h, st_h = fetched[:7]
-        y_h = fetched[7] if want_y else None
+        x_h, obj_h, conv_h, iters_h, pr_h, gap_h, st_h, rst_h = fetched[:8]
+        y_h = fetched[8] if want_y else None
         k = len(lps_dev)
         if np.ndim(x_h) == 1:
             dev_x = [np.asarray(x_h)]
@@ -1495,6 +1495,7 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
             dev_pr = [float(pr_h)]
             dev_gap = [float(gap_h)]
             dev_st = [int(st_h)]
+            dev_rst = [int(rst_h)]
             dev_y = [np.asarray(y_h)] if y_h is not None else None
         else:
             # [:k] trims the serving layer's bucket-padding rows (a
@@ -1509,6 +1510,8 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
             dev_gap = [float(v) for v in np.atleast_1d(
                 np.asarray(gap_h))[:k]]
             dev_st = [int(s) for s in np.asarray(st_h)[:k]]
+            dev_rst = [int(v) for v in np.atleast_1d(
+                np.asarray(rst_h))[:k]]
             dev_y = (list(np.asarray(y_h)[:k]) if y_h is not None
                      else None)
     if iterate_sink is not None:
@@ -1527,6 +1530,7 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
     iters_m = np.zeros(n_mem, np.int64)
     pr_m = np.zeros(n_mem)
     gap_m = np.zeros(n_mem)
+    rst_m = np.zeros(n_mem, np.int64)
     for row, i in enumerate(dev_idx):
         xs[i] = dev_x[row]
         objs[i] = dev_obj[row]
@@ -1535,6 +1539,7 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
         iters_m[i] = dev_it[row]
         pr_m[i] = dev_pr[row]
         gap_m[i] = dev_gap[row]
+        rst_m[i] = dev_rst[row]
     for i in range(n_mem):
         if substituted[i]:
             mp = plan_w[i]
@@ -1594,12 +1599,22 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
         cache.note_iters(key, float(np.percentile(iters_m, 50)))
     if ledger is not None:
         it = iters_m
-        from ..ops.pdhg import kernel_selection
+        from ..ops.pdhg import kernel_selection, resolved_variant
         kern, kern_why = kernel_selection(
             solver, batched=not (len(lps_dev) == 1 and pad_to is None))
         entry = {**(ledger_meta or {}),
                  "backend": backend, "m": lp0.m, "n": lp0.n,
                  "batch": len(lps),
+                 # solver-core observables (ROADMAP item 1): the step
+                 # variant this group's jits BAKED IN at build time (a
+                 # live env flip only reaches rebuilt solvers), its
+                 # adaptive-restart count (== Halpern anchor resets
+                 # under 'halpern'), and the realized check cadence
+                 "variant": (getattr(solver, "variant", None)
+                             or resolved_variant(solver.opts)),
+                 "restarts": int(rst_m.sum()),
+                 "restarts_p50": int(np.percentile(rst_m, 50)),
+                 "cadence_final": int(stats.cadence_final),
                  # chosen chunk kernel + fallback reason (ROADMAP item 4:
                  # BENCH_r03's silent scan fallback becomes a measured,
                  # gateable observable)
@@ -1632,6 +1647,9 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
                     "exact": sum(1 for mp in plan_w
                                  if mp.kind == "exact"),
                     "near": sum(1 for mp in plan_w if mp.kind == "near"),
+                    # learned-predictor grade (ops/seedpredict.py)
+                    "predicted": sum(1 for mp in plan_w
+                                     if mp.kind == "predicted"),
                     "substituted": int(sum(substituted)),
                     "stale_seed_faults": sum(1 for mp in plan_w
                                              if mp.stale_fault),
@@ -1639,7 +1657,7 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
             else:
                 seeded_i = list(range(n_mem))
                 warm = {"source": "failed_iterate", "exact": 0,
-                        "near": n_mem, "substituted": 0,
+                        "near": n_mem, "predicted": 0, "substituted": 0,
                         "stale_seed_faults": 0}
             cold_i = [i for i in range(n_mem) if i not in set(seeded_i)]
             warm["seeded"] = len(seeded_i)
@@ -1650,6 +1668,11 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
                 int(np.percentile(it_seeded, 50)) if it_seeded else None)
             warm["iters_p50_cold"] = (
                 int(np.percentile(it_cold, 50)) if it_cold else None)
+            it_pred = ([int(iters_m[i]) for i in range(n_mem)
+                        if plan_w[i].kind == "predicted"]
+                       if plan_w is not None else [])
+            warm["iters_p50_predicted"] = (
+                int(np.percentile(it_pred, 50)) if it_pred else None)
             base = (memory.cold_p50(key) if memory is not None
                     and key is not None else None)
             warm["baseline_cold_p50"] = base
@@ -1658,6 +1681,7 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
                 if base is not None and it_seeded else None)
             warm["_iters_seeded"] = it_seeded
             warm["_iters_cold"] = it_cold
+            warm["_iters_predicted"] = it_pred
             entry["warm"] = warm
         if staged is not None:
             # staged staging ran on the dispatch thread, OVERLAPPED with
@@ -2447,9 +2471,19 @@ def summarize_solve_ledger(entries, dispatch_solve_s: float,
     iters_all = []
     warm_seeded_it: list = []
     warm_cold_it: list = []
+    warm_pred_it: list = []
     warm_tot = {"seeded": 0, "cold": 0, "substituted": 0, "exact": 0,
-                "near": 0, "stale_seed_faults": 0, "iters_saved": 0}
+                "near": 0, "predicted": 0, "stale_seed_faults": 0,
+                "iters_saved": 0}
     warm_seen = False
+    # solver-core aggregation (ROADMAP item 1): which step variant each
+    # group ran, total adaptive restarts (== Halpern anchor resets under
+    # 'halpern'), and the realized check cadences
+    from collections import Counter as _Counter
+    core_variants: "_Counter" = _Counter()
+    core_restarts = 0
+    core_anchor_resets = 0
+    core_cadences: list = []
     for e in entries:
         e = dict(e)
         it = e.pop("_iters", None)
@@ -2463,10 +2497,12 @@ def summarize_solve_ledger(entries, dispatch_solve_s: float,
             w = e["warm"] = dict(w)
             s_it = w.pop("_iters_seeded", None) or []
             c_it = w.pop("_iters_cold", None) or []
+            p_it = w.pop("_iters_predicted", None) or []
             if e.get("rung") in (None, "initial"):
                 warm_seen = True
                 warm_seeded_it.extend(int(v) for v in s_it)
                 warm_cold_it.extend(int(v) for v in c_it)
+                warm_pred_it.extend(int(v) for v in p_it)
                 for k in warm_tot:
                     warm_tot[k] += int(w.get(k) or 0)
         if e.get("backend") != "cpu":
@@ -2474,6 +2510,13 @@ def summarize_solve_ledger(entries, dispatch_solve_s: float,
                         ("stack_s", "h2d_s", "sync_wait_s",
                          "result_fetch_s"))
             e["other_s"] = round(max(0.0, e.get("solve_s", 0.0) - known), 4)
+        if e.get("variant"):
+            core_variants[e["variant"]] += 1
+            core_restarts += int(e.get("restarts") or 0)
+            if e["variant"] == "halpern":
+                core_anchor_resets += int(e.get("restarts") or 0)
+            if e.get("cadence_final"):
+                core_cadences.append(int(e["cadence_final"]))
         for k in totals:
             totals[k] += float(e.get(k, 0.0))
         for k in counts:
@@ -2518,6 +2561,19 @@ def summarize_solve_ledger(entries, dispatch_solve_s: float,
             "runtime_disabled": bool(_pc.RUNTIME_DISABLED),
             "runtime_disabled_reason": _pc.RUNTIME_DISABLED_REASON,
         }
+    if core_variants:
+        # solver-core observable (surfaces in service.metrics() too):
+        # the variant mix actually running, restart/anchor-reset volume,
+        # and the realized adaptive check cadence across groups
+        out["solver_core"] = {
+            "variants": dict(core_variants),
+            "restarts": int(core_restarts),
+            "anchor_resets": int(core_anchor_resets),
+            "cadence_final_max": (max(core_cadences)
+                                  if core_cadences else None),
+            "cadence_final_min": (min(core_cadences)
+                                  if core_cadences else None),
+        }
     if warm_seen:
         # dispatch-level seeded-vs-cold split (initial rungs): the
         # published warm-start observable the smoke/bench gates read
@@ -2530,6 +2586,8 @@ def summarize_solve_ledger(entries, dispatch_solve_s: float,
                                  if warm_seeded_it else None),
             "iters_p50_cold": (int(np.percentile(warm_cold_it, 50))
                                if warm_cold_it else None),
+            "iters_p50_predicted": (int(np.percentile(warm_pred_it, 50))
+                                    if warm_pred_it else None),
         }
     return out
 

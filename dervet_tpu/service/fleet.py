@@ -396,13 +396,16 @@ class LocalReplica(ReplicaHandle):
         mem = self.service.solver_cache.memory
         if mem is None:
             return None
-        return pickle.dumps(mem.export_entries(),
+        # entries + learned seed models (ops/seedpredict.py) in one
+        # payload; import_payload on the receiving side accepts both
+        # this dict and the older bare-entries list
+        return pickle.dumps(mem.export_payload(),
                             protocol=pickle.HIGHEST_PROTOCOL)
 
     def import_memory(self, blob: bytes) -> None:
         mem = self.service.solver_cache.memory
         if mem is not None:
-            mem.import_entries(pickle.loads(blob))
+            mem.import_payload(pickle.loads(blob))
 
     def snapshot(self) -> Dict:
         return {"name": self.name, "state": self.state,

@@ -691,9 +691,16 @@ class ScenarioService:
                 "compile_events_total": rounds["compile_events"],
             },
             # warm-start solution memory (ops/warmstart.py): entry
-            # counts, hit grades, substitutions, stale-seed drills
+            # counts, hit grades (incl. the learned-predictor grade),
+            # substitutions, stale-seed drills, predictor model stats
             "warm_start": (cache.memory.snapshot()
                            if cache.memory is not None else None),
+            # solver core (ops/pdhg.py variants + adaptive cadence):
+            # the last round's variant mix, restart/anchor-reset volume,
+            # and realized check cadence — the per-group detail lives in
+            # the round ledger's entries
+            "solver_core": (self.last_round_ledger or {}
+                            ).get("solver_core"),
             "service": {"backend": self.backend,
                         "started": self._started,
                         "draining": self._draining.is_set(),
@@ -853,10 +860,10 @@ def serve_main(argv=None) -> int:
             return
         for f in sorted(memory_in.glob("*.pkl")):
             try:
-                n = mem.import_entries(pickle.loads(f.read_bytes()))
+                n = mem.import_payload(pickle.loads(f.read_bytes()))
                 TellUser.info(f"serve: imported {n} warm-start entr"
                               f"{'y' if n == 1 else 'ies'} from "
-                              f"{f.name} (exact-only)")
+                              f"{f.name} (exact-only, + seed models)")
             except Exception as e:
                 TellUser.warning(
                     f"serve: warm-start import {f.name} unreadable "
@@ -871,7 +878,7 @@ def serve_main(argv=None) -> int:
                 hb_state["mem_stores"] = stores
                 atomic_write(spool / fleet_mod.MEMORY_EXPORT_FILE,
                              pickle.dumps(
-                                 mem.export_entries(),
+                                 mem.export_payload(),
                                  protocol=pickle.HIGHEST_PROTOCOL))
 
     def replica_tick() -> None:

@@ -141,7 +141,10 @@ def main() -> int:
     # -- straggler drill: device 0 slowed, >= 1 steal --------------------
     os.environ["DERVET_TPU_FAULT_STRAGGLER"] = "1"
     os.environ["DERVET_TPU_FAULT_STRAGGLER_DEVICE"] = "0"
-    os.environ["DERVET_TPU_FAULT_STRAGGLER_S"] = "0.6"
+    # 1.5 s: the slowdown must dwarf one group's solve for the steal
+    # window to open deterministically (the r14 reflected default cut
+    # solve times ~30%; 0.6 s started racing the victim's queue drain)
+    os.environ["DERVET_TPU_FAULT_STRAGGLER_S"] = "1.5"
     try:
         svc_f, results_f = _serve(_workload(n_lengths, cases_per))
         try:

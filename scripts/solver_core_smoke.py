@@ -1,0 +1,180 @@
+"""CI smoke: the solver-core leap (step variants + learned seeding) on
+the cpu XLA backend, no chip.
+
+Two stages:
+
+**Variant stage** (direct solver, fixed case set): one monthly dispatch
+window, a fixed batch of perturbed-price instances, solved cold under
+``variant='vanilla'`` and under the product default — gates a >= 30%
+median cold-iteration reduction from the step variant ALONE, with every
+instance converged under both.
+
+**Service stage** (full serving path): a ScenarioService serves
+
+1. a COLD request (baseline; trains the warm-start memory and the seed
+   predictor, compiles the whole program family);
+2. a PERTURBED request (same structures, ~1% different data — the
+   structure-repeat cold shape): gates 100% certification, ZERO compile
+   events (no new shapes on a warm service), and at least one
+   ``predicted``-grade seed in the round ledger;
+3. the same perturbed shape again under an injected ``stale_seed``
+   fault (the corrupted-prediction fault-matrix row): the corrupted
+   seeds must still converge and certify 100%, with the faults
+   attributed in the ledger (``warm.stale_seed_faults``) — a bad
+   prediction costs iterations, never correctness.
+
+Env knobs: SMOKE_CASES (default 4), SMOKE_MONTHS (default 1),
+SMOKE_BATCH (default 8).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def variant_stage(batch: int) -> dict:
+    """Median cold-iteration reduction, vanilla -> default variant."""
+    from dervet_tpu.benchlib import build_window_lps, synthetic_case
+    from dervet_tpu.ops.pdhg import (CompiledLPSolver, PDHGOptions,
+                                     resolved_variant)
+
+    case = synthetic_case()
+    _, groups = build_window_lps(case)
+    lp0 = sorted(groups.items())[0][1][0]
+    rng = np.random.default_rng(0)
+    C = np.stack([lp0.c * (1 + 0.05 * rng.standard_normal(lp0.c.shape))
+                  for _ in range(batch)])
+
+    out = {}
+    for label, opts in (("vanilla", PDHGOptions(variant="vanilla")),
+                        ("variant", PDHGOptions())):
+        res = CompiledLPSolver(lp0, opts).solve(c=C)
+        it = np.asarray(res.iters)
+        conv = int(np.asarray(res.converged).sum())
+        if conv != batch:
+            raise AssertionError(
+                f"{label}: only {conv}/{batch} instances converged")
+        out[label] = {"iters_p50": int(np.percentile(it, 50)),
+                      "iters_p99": int(np.percentile(it, 99)),
+                      "variant": resolved_variant(opts),
+                      "restarts": int(np.asarray(res.restarts).sum())}
+    red = 1.0 - out["variant"]["iters_p50"] / out["vanilla"]["iters_p50"]
+    out["reduction"] = round(red, 4)
+    if red < 0.30:
+        raise AssertionError(
+            f"variant-alone cold-iteration reduction {red:.1%} < 30% "
+            f"(vanilla p50 {out['vanilla']['iters_p50']}, "
+            f"{out['variant']['variant']} p50 "
+            f"{out['variant']['iters_p50']})")
+    return out
+
+
+def _assert_certified(res, n_windows: int, label: str) -> None:
+    cert = res.run_health["certification"]
+    if not cert["enabled"] or cert["windows_certified"] != n_windows \
+            or cert["windows"]["rejected_final"]:
+        raise AssertionError(f"{label}: not 100% certified: {cert}")
+
+
+def service_stage(n_cases: int, months: int) -> dict:
+    from dervet_tpu.benchlib import (synthetic_sensitivity_cases,
+                                     validate_solve_ledger)
+    from dervet_tpu.service import ScenarioService
+    from dervet_tpu.utils import faultinject
+
+    def perturbed(scale):
+        fam = synthetic_sensitivity_cases(n_cases, months=months)
+        for c in fam:
+            for tag, _, keys in c.ders:
+                if tag == "Battery":
+                    keys["ene_max_rated"] *= scale
+        return {i: c for i, c in enumerate(fam)}
+
+    svc = ScenarioService(backend="jax", max_wait_s=0.0)
+    svc.start()
+    try:
+        cold_res = svc.submit(perturbed(1.0),
+                              request_id="sc-cold").result(timeout=600)
+        cold_led = svc.last_round_ledger
+        warm_res = svc.submit(perturbed(1.01),
+                              request_id="sc-warm").result(timeout=600)
+        warm_led = svc.last_round_ledger
+        with faultinject.inject(stale_seed={"all"}):
+            fault_res = svc.submit(perturbed(1.02),
+                                   request_id="sc-fault").result(
+                                       timeout=600)
+        fault_led = svc.last_round_ledger
+        metrics = svc.metrics()
+    finally:
+        svc.drain()
+
+    validate_solve_ledger(warm_led)
+    n_windows = sum(len(inst.scenario.windows)
+                    for inst in warm_res.instances.values())
+    _assert_certified(cold_res, n_windows, "cold pass")
+    _assert_certified(warm_res, n_windows, "perturbed pass")
+    _assert_certified(fault_res, n_windows, "fault pass")
+
+    warm = warm_led.get("warm_start") or {}
+    if int(warm_led["totals"]["compile_events"]):
+        raise AssertionError(
+            f"perturbed pass compiled "
+            f"{warm_led['totals']['compile_events']} program(s) — the "
+            "variant/seeded program family must be part of the cold "
+            "round's warm-up (no new shapes on a warm service)")
+    if not warm.get("predicted"):
+        raise AssertionError(
+            f"perturbed pass served no predicted-grade seeds: {warm}")
+    core = warm_led.get("solver_core") or {}
+    if not core.get("variants"):
+        raise AssertionError(f"no solver_core section in ledger: {core}")
+
+    fault_warm = fault_led.get("warm_start") or {}
+    if not fault_warm.get("stale_seed_faults"):
+        raise AssertionError(
+            "corrupted-prediction pass recorded no stale_seed faults: "
+            f"{fault_warm}")
+
+    cold_p50 = (cold_led.get("warm_start") or {}).get("iters_p50_cold") \
+        or cold_led["iters"]["p50"]
+    return {
+        "windows": n_windows,
+        "iters_p50_cold": int(cold_p50),
+        "perturbed": {
+            "iters_p50_seeded": warm.get("iters_p50_seeded"),
+            "iters_p50_predicted": warm.get("iters_p50_predicted"),
+            "predicted": warm.get("predicted"),
+            "compile_events": int(warm_led["totals"]["compile_events"]),
+        },
+        "fault": {
+            "stale_seed_faults": fault_warm.get("stale_seed_faults"),
+            "iters_p50_seeded": fault_warm.get("iters_p50_seeded"),
+        },
+        "solver_core": core,
+        "memory": metrics["warm_start"],
+    }
+
+
+def main() -> int:
+    n_cases = int(os.environ.get("SMOKE_CASES", "4"))
+    months = int(os.environ.get("SMOKE_MONTHS", "1"))
+    batch = int(os.environ.get("SMOKE_BATCH", "8"))
+    out = {"smoke": "solver_core", "ok": True,
+           "variant_stage": variant_stage(batch),
+           "service_stage": service_stage(n_cases, months)}
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

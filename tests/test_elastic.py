@@ -351,8 +351,12 @@ class TestStragglerDrill:
         _clear_env()
         try:
             os.environ[elastic.ELASTIC_DEVICES_ENV] = "2"
+            # 1.5 s, not 0.6: the slowdown must dwarf one group's solve
+            # for the steal window to open deterministically — the r14
+            # reflected default cut solve times ~30% and the old margin
+            # started racing the victim's own queue drain
             with faultinject.inject(straggler=True, straggler_device=0,
-                                    straggler_seconds=0.6) as plan:
+                                    straggler_seconds=1.5) as plan:
                 scens = [MicrogridScenario(c) for c in _mixed_cases()]
                 run_dispatch(scens, backend="jax")
         finally:

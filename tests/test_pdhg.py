@@ -602,3 +602,127 @@ def test_pallas_compile_failure_classifier():
         assert is_pallas_compile_failure(Exception(msg)), msg
     for msg in passed_through:
         assert not is_pallas_compile_failure(Exception(msg)), msg
+
+
+class TestStepVariants:
+    """Reflected / Halpern-anchored PDHG (ops/pdhg.py variants): same
+    answers as vanilla within tolerance, fewer iterations, the same
+    certificates — and the DERVET_TPU_PDHG_VARIANT kill switch restores
+    the vanilla iteration bit for bit."""
+
+    @pytest.mark.parametrize("variant", ["vanilla", "reflected", "halpern"])
+    def test_variant_matches_higgs(self, variant):
+        lp = battery_like_lp(T=96)
+        ref = solve_lp_cpu(lp)
+        res = CompiledLPSolver(lp, PDHGOptions(variant=variant)).solve()
+        assert bool(res.converged)
+        assert abs(float(res.obj) - ref.obj) / max(1.0, abs(ref.obj)) < 1e-3
+
+    def test_reflected_cuts_iterations(self):
+        """The acceptance direction on a dispatch-shaped LP: the default
+        reflected step needs strictly fewer iterations than vanilla
+        (both deterministic, so this is a fixed comparison, not a
+        flaky benchmark)."""
+        lp = battery_like_lp(T=96)
+        it = {}
+        for variant in ("vanilla", "reflected"):
+            res = CompiledLPSolver(lp, PDHGOptions(variant=variant)).solve()
+            assert bool(res.converged)
+            it[variant] = int(res.iters)
+        assert it["reflected"] < it["vanilla"]
+
+    def test_restarts_counted(self):
+        lp = battery_like_lp(T=96)
+        res = CompiledLPSolver(lp, PDHGOptions()).solve()
+        assert int(res.restarts) > 0
+        # batched: per-member counts ride the same fused result
+        resb = CompiledLPSolver(lp, PDHGOptions()).solve(
+            c=np.stack([lp.c, lp.c * 1.01]))
+        assert np.asarray(resb.restarts).shape == (2,)
+        assert int(np.asarray(resb.restarts).min()) > 0
+
+    @pytest.mark.parametrize("variant", ["reflected", "halpern"])
+    def test_infeasibility_certificate_survives_variant(self, variant):
+        from dervet_tpu.ops.pdhg import STATUS_PRIMAL_INFEASIBLE
+        b = LPBuilder()
+        v = b.var("x", 4, 0, 1)
+        b.add_rows("impossible_demand", [(v, np.ones((1, 4)))], "ge", 100.0)
+        b.add_cost(v, np.ones(4))
+        lp = b.build()
+        res = CompiledLPSolver(
+            lp, PDHGOptions(variant=variant, max_iters=100_000)).solve()
+        assert int(res.status) == STATUS_PRIMAL_INFEASIBLE
+        assert int(res.iters) < 20_000
+
+    def test_kill_switch_restores_vanilla_bitwise(self, monkeypatch):
+        """DERVET_TPU_PDHG_VARIANT=vanilla on a halpern-configured solver
+        reproduces the vanilla solver's results bit for bit — the
+        operator kill path."""
+        lp = battery_like_lp(T=48)
+        vanilla = CompiledLPSolver(
+            lp, PDHGOptions(variant="vanilla")).solve()
+        monkeypatch.setenv("DERVET_TPU_PDHG_VARIANT", "vanilla")
+        killed = CompiledLPSolver(
+            lp, PDHGOptions(variant="halpern")).solve()
+        assert np.array_equal(np.asarray(killed.x), np.asarray(vanilla.x))
+        assert np.array_equal(np.asarray(killed.y), np.asarray(vanilla.y))
+        assert int(killed.iters) == int(vanilla.iters)
+
+    def test_env_forces_variant(self, monkeypatch):
+        from dervet_tpu.ops.pdhg import resolved_variant
+        monkeypatch.setenv("DERVET_TPU_PDHG_VARIANT", "halpern")
+        assert resolved_variant(PDHGOptions(variant="vanilla")) == "halpern"
+        monkeypatch.setenv("DERVET_TPU_PDHG_VARIANT", "not-a-variant")
+        # typo'd env is ignored (warn once), options win
+        assert resolved_variant(PDHGOptions(variant="reflected")) \
+            == "reflected"
+        monkeypatch.delenv("DERVET_TPU_PDHG_VARIANT")
+        with pytest.raises(ValueError, match="variant"):
+            resolved_variant(PDHGOptions(variant="bogus"))
+
+    def test_variant_rides_scan_kernel_reason(self):
+        """kernel_selection must report a non-vanilla variant as an
+        EXPECTED scan reason, never the runtime_disabled regression
+        prefix the bench gate fails on."""
+        from dervet_tpu.ops.pdhg import kernel_selection
+        lp = battery_like_lp(T=48)
+        solver = CompiledLPSolver(lp, PDHGOptions(variant="reflected"))
+        kern, why = kernel_selection(solver, batched=True)
+        assert kern == "xla_scan"
+        assert "variant" in why and not why.startswith("runtime_disabled")
+
+
+class TestAdaptiveCadence:
+    """The restart/termination check cadence starts short and backs off
+    geometrically (PDHGOptions.check_every_min), so short seeded solves
+    exit near their true iteration count instead of overshooting by most
+    of a fixed 128-iteration window."""
+
+    def test_seeded_solve_exits_before_first_legacy_check(self):
+        lp = battery_like_lp(T=96)
+        solver = CompiledLPSolver(lp, PDHGOptions(pallas_chunk=False))
+        cold = solver.solve()
+        warm = solver.solve(x0=np.asarray(cold.x), y0=np.asarray(cold.y))
+        assert bool(warm.converged)
+        # a fixed cadence of 128 cannot report fewer than 128 iterations;
+        # the adaptive schedule catches the re-solve at its first checks
+        assert int(warm.iters) < solver.opts.check_every
+
+    def test_realized_cadence_recorded_and_saturates(self):
+        lp = battery_like_lp(T=96)
+        solver = CompiledLPSolver(lp, PDHGOptions(pallas_chunk=False))
+        res = solver.solve()
+        assert bool(res.converged)
+        # a cold solve runs long enough to saturate the schedule
+        assert solver.last_stats.cadence_final == solver.opts.check_every
+
+    def test_disabled_cadence_matches_legacy_fixed_schedule(self):
+        """check_every_min=0 restores the fixed-cadence path: iteration
+        counts quantize to whole check_every windows again."""
+        lp = battery_like_lp(T=96)
+        solver = CompiledLPSolver(
+            lp, PDHGOptions(pallas_chunk=False, check_every_min=0))
+        res = solver.solve()
+        assert bool(res.converged)
+        assert int(res.iters) % solver.opts.check_every == 0
+        assert solver.last_stats.cadence_final == solver.opts.check_every

@@ -1,0 +1,187 @@
+"""Learned cold-start seed predictor (ops/seedpredict.py).
+
+The SolutionMemory doubles as a training set: per structure key a cheap
+ridge model maps the float16-quantized LP feature vector to initial
+iterates, served as the ``predicted`` warm-start grade — below ``near``
+(a genuinely nearby stored iterate wins), above the nearest-by-feature
+fallback and cold.  Safety: every predicted-seeded window still runs the
+full convergence criteria + float64 certification, the ``stale_seed``
+fault drill covers the corrupted-prediction shape, and a certificate
+rejection drops the structure's model (the training set just proved
+untrustworthy there).
+"""
+import copy
+
+import numpy as np
+import pytest
+
+from dervet_tpu.ops import seedpredict, warmstart
+from dervet_tpu.ops.pdhg import CompiledLPSolver, PDHGOptions
+from dervet_tpu.utils import faultinject
+from tests.test_warmstart import _arb_lp
+
+
+def _trained_memory(solver, lp, n_entries=6, spread=0.1):
+    """Memory with ``n_entries`` converged price variants stored."""
+    mem = warmstart.SolutionMemory(max_entries=64)
+    tag = warmstart.opts_tag(solver.opts)
+    for i in range(n_entries):
+        lpi = copy.copy(lp)
+        lpi.c = lp.c * (1.0 - spread * n_entries / 2 + spread * i)
+        r = solver.solve(c=lpi.c)
+        assert bool(r.converged)
+        mem.store("sk", lpi, tag, np.asarray(r.x), np.asarray(r.y),
+                  float(r.obj))
+    return mem
+
+
+def _far_instance(lp, seed=0):
+    """Data far (in quantized-digest terms) from every stored entry —
+    the feature-fallback / predicted zone."""
+    rng = np.random.default_rng(seed)
+    lpq = copy.copy(lp)
+    lpq.c = lp.c * 1.04 + 0.002 * rng.standard_normal(lp.n) \
+        * np.abs(lp.c).mean()
+    return lpq
+
+
+class TestPredictorModel:
+    def test_fit_predict_reduces_iterations(self):
+        lp = _arb_lp()
+        opts = PDHGOptions(pallas_chunk=False)
+        solver = CompiledLPSolver(lp, opts)
+        mem = _trained_memory(solver, lp)
+        plans = warmstart.plan_group(mem, "sk", [_far_instance(lp)],
+                                     opts, ["w0"])
+        assert plans[0].kind == "predicted"
+        lpq = _far_instance(lp)
+        cold = solver.solve(c=lpq.c)
+        seeded = solver.solve(c=lpq.c, x0=plans[0].entry.x,
+                              y0=plans[0].entry.y)
+        assert bool(seeded.converged)
+        assert int(seeded.iters) < int(cold.iters)
+        snap = mem.snapshot()["predictor"]
+        assert snap["models"] == 1 and snap["fits"] >= 1
+        assert mem.snapshot()["hits_predicted"] >= 1
+
+    def test_abstains_below_min_entries(self):
+        lp = _arb_lp()
+        opts = PDHGOptions(pallas_chunk=False)
+        solver = CompiledLPSolver(lp, opts)
+        mem = _trained_memory(solver, lp, n_entries=2)  # < min_entries
+        plans = warmstart.plan_group(mem, "sk", [_far_instance(lp)],
+                                     opts, ["w0"])
+        # nearest-by-feature fallback still serves (reported as near)
+        assert plans[0].kind == "near"
+        assert mem.snapshot()["predictor"]["models"] == 0
+
+    def test_near_grade_outranks_prediction(self):
+        """A quantized-digest hit (genuinely nearby stored iterate) must
+        win over the model interpolation."""
+        lp = _arb_lp()
+        opts = PDHGOptions(pallas_chunk=False)
+        solver = CompiledLPSolver(lp, opts)
+        mem = _trained_memory(solver, lp)
+        stored = mem.entries_for_structure("sk")[-1]
+        # repeat one stored instance's data (same quant digest), at a
+        # different tolerance tag so the exact grade cannot fire
+        lp_same = copy.copy(lp)
+        lp_same.c = lp.c * (1.0 + 0.1 * (6 / 2 - 1) - 0.1 * 2)
+        loose = PDHGOptions.screening(opts)
+        plans = warmstart.plan_group(mem, "sk", [lp_same], loose, ["w0"])
+        assert plans[0].kind in ("near", "exact")
+        assert plans[0].entry is not None and plans[0].entry.exact != b""
+        del stored
+
+    def test_kill_switch_disables_predictions(self, monkeypatch):
+        lp = _arb_lp()
+        opts = PDHGOptions(pallas_chunk=False)
+        solver = CompiledLPSolver(lp, opts)
+        mem = _trained_memory(solver, lp)
+        monkeypatch.setenv("DERVET_TPU_SEEDPREDICT", "0")
+        plans = warmstart.plan_group(mem, "sk", [_far_instance(lp)],
+                                     opts, ["w0"])
+        assert plans[0].kind == "near"      # feature fallback, no model
+        assert mem.snapshot()["predictor"]["predictions"] == 0
+
+    def test_invalidate_drops_model(self):
+        lp = _arb_lp()
+        opts = PDHGOptions(pallas_chunk=False)
+        solver = CompiledLPSolver(lp, opts)
+        mem = _trained_memory(solver, lp)
+        warmstart.plan_group(mem, "sk", [_far_instance(lp)], opts, ["w0"])
+        assert mem.predictor.has_model("sk")
+        # the certificate-rejection path: memory.invalidate drops the
+        # structure's model alongside the offending entries
+        mem.invalidate("sk", lp, np.dtype(opts.dtype))
+        assert not mem.predictor.has_model("sk")
+        assert mem.snapshot()["predictor"]["invalidated"] == 1
+
+    def test_nonfinite_prediction_rejected(self):
+        pred = seedpredict.SeedPredictor()
+        bad = [("sk", {"W": np.full((33, 8), np.nan), "n": 4, "m": 4,
+                       "trained_on": 5})]
+        assert pred.import_models(bad) == 0
+        assert pred.predict("sk", np.zeros(32)) is None
+
+
+class TestPredictorFleetHandoff:
+    def test_export_import_models(self):
+        lp = _arb_lp()
+        opts = PDHGOptions(pallas_chunk=False)
+        solver = CompiledLPSolver(lp, opts)
+        mem = _trained_memory(solver, lp)
+        warmstart.plan_group(mem, "sk", [_far_instance(lp)], opts, ["w0"])
+        import pickle
+        blob = pickle.dumps(mem.export_payload())
+        other = warmstart.SolutionMemory(max_entries=64)
+        n = other.import_payload(pickle.loads(blob))
+        assert n == 6
+        assert other.predictor.snapshot()["models"] == 1
+        # imported models predict for a structure the replica never
+        # solved (entries imported exact-only: no near indices)
+        plans = warmstart.plan_group(other, "sk", [_far_instance(lp)],
+                                     opts, ["w0"])
+        assert plans[0].kind == "predicted"
+
+    def test_legacy_entries_list_still_imports(self):
+        lp = _arb_lp()
+        opts = PDHGOptions(pallas_chunk=False)
+        solver = CompiledLPSolver(lp, opts)
+        mem = _trained_memory(solver, lp, n_entries=3)
+        other = warmstart.SolutionMemory(max_entries=64)
+        assert other.import_payload(mem.export_entries()) == 3
+        assert other.predictor.snapshot()["models"] == 0
+
+    def test_local_models_win_over_imports(self):
+        lp = _arb_lp()
+        opts = PDHGOptions(pallas_chunk=False)
+        solver = CompiledLPSolver(lp, opts)
+        mem = _trained_memory(solver, lp)
+        warmstart.plan_group(mem, "sk", [_far_instance(lp)], opts, ["w0"])
+        local_w = mem.predictor._models["sk"].W.copy()
+        foreign = [("sk", {"W": np.zeros_like(local_w), "n": lp.n,
+                           "m": lp.m, "trained_on": 99})]
+        assert mem.predictor.import_models(foreign) == 0
+        assert np.array_equal(mem.predictor._models["sk"].W, local_w)
+
+
+class TestCorruptedPrediction:
+    def test_corrupt_prediction_converges_and_is_attributed(self):
+        """The fault-matrix row: a corrupted prediction (stale_seed
+        fault on a predicted member) still converges under the normal
+        criteria, is attributed in the plan (stale_fault, predicted
+        kind), and only costs iterations."""
+        lp = _arb_lp()
+        opts = PDHGOptions(pallas_chunk=False)
+        solver = CompiledLPSolver(lp, opts)
+        mem = _trained_memory(solver, lp)
+        lpq = _far_instance(lp)
+        with faultinject.inject(stale_seed={"all"}):
+            plans = warmstart.plan_group(mem, "sk", [lpq], opts, ["w0"])
+        assert plans[0].kind == "predicted"
+        assert plans[0].stale_fault
+        assert mem.snapshot()["stale_seed_faults"] >= 1
+        res = solver.solve(c=lpq.c, x0=plans[0].entry.x,
+                           y0=plans[0].entry.y)
+        assert bool(res.converged)      # a bad seed never breaks a solve

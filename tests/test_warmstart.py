@@ -527,3 +527,65 @@ class TestDesignTierSeeding:
             list(f2.frontier["candidate"])
         assert list(f1.frontier["total"]) == list(f2.frontier["total"])
         assert f1.all_finalists_certified and f2.all_finalists_certified
+
+
+# ---------------------------------------------------------------------------
+# Variant x seeding interaction (solver-core PR): the seeded init program
+# and every step variant compose without perturbing cold members
+# ---------------------------------------------------------------------------
+
+class TestVariantSeeding:
+    @pytest.mark.parametrize("variant", ["vanilla", "reflected", "halpern"])
+    def test_zero_seed_is_cold_start_bitwise(self, variant):
+        lp = _arb_lp()
+        solver = CompiledLPSolver(
+            lp, PDHGOptions(pallas_chunk=False, variant=variant))
+        C = np.stack([lp.c, lp.c * 1.01, lp.c * 0.99])
+        cold = solver.solve(c=C)
+        zero = solver.solve(c=C, x0=np.zeros((3, lp.n)),
+                            y0=np.zeros((3, lp.m)))
+        assert np.array_equal(np.asarray(cold.x), np.asarray(zero.x))
+        assert np.array_equal(np.asarray(cold.iters),
+                              np.asarray(zero.iters))
+
+    @pytest.mark.parametrize("variant", ["vanilla", "reflected", "halpern"])
+    def test_partial_seed_leaves_cold_members_bitwise(self, variant):
+        lp = _arb_lp()
+        solver = CompiledLPSolver(
+            lp, PDHGOptions(pallas_chunk=False, variant=variant))
+        C = np.stack([lp.c, lp.c * 1.02, lp.c * 0.98])
+        cold = solver.solve(c=C)
+        X0 = np.zeros((3, lp.n))
+        Y0 = np.zeros((3, lp.m))
+        X0[0] = np.asarray(cold.x)[0]
+        Y0[0] = np.asarray(cold.y)[0]
+        mixed = solver.solve(c=C, x0=X0, y0=Y0)
+        assert np.asarray(mixed.iters)[0] <= np.asarray(cold.iters)[0]
+        for i in (1, 2):
+            assert np.array_equal(np.asarray(mixed.x)[i],
+                                  np.asarray(cold.x)[i])
+
+    def test_kill_switch_restores_vanilla_seeded_bitwise(self, monkeypatch):
+        """The env kill switch restores vanilla for the SEEDED program
+        too — seeding and the variant knob are orthogonal."""
+        lp = _arb_lp()
+        base = CompiledLPSolver(
+            lp, PDHGOptions(pallas_chunk=False, variant="vanilla"))
+        cold = base.solve()
+        ref = base.solve(x0=np.asarray(cold.x), y0=np.asarray(cold.y))
+        monkeypatch.setenv("DERVET_TPU_PDHG_VARIANT", "vanilla")
+        killed = CompiledLPSolver(
+            lp, PDHGOptions(pallas_chunk=False, variant="halpern"))
+        warm = killed.solve(x0=np.asarray(cold.x), y0=np.asarray(cold.y))
+        assert np.array_equal(np.asarray(warm.x), np.asarray(ref.x))
+        assert int(warm.iters) == int(ref.iters)
+
+    @pytest.mark.parametrize("variant", ["reflected", "halpern"])
+    def test_own_solution_seed_converges_fast(self, variant):
+        lp = _arb_lp()
+        solver = CompiledLPSolver(
+            lp, PDHGOptions(pallas_chunk=False, variant=variant))
+        cold = solver.solve()
+        warm = solver.solve(x0=np.asarray(cold.x), y0=np.asarray(cold.y))
+        assert bool(warm.converged)
+        assert int(warm.iters) < int(cold.iters)
