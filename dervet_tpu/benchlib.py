@@ -160,9 +160,9 @@ LEDGER_JAX_GROUP_KEYS = (
     "iters_max", "dispatches", "chunks", "compile_events", "h2d_bytes",
     "h2d_s", "readbacks", "sync_wait_s", "result_fetch_s",
     "bucket_occupancy", "other_s",
-    # solver-core observables (PR 11): step variant, adaptive-restart
-    # count, realized check cadence
-    "variant", "restarts", "cadence_final")
+    # solver-core observables (PR 11/12): step variant, restart
+    # criterion, adaptive-restart count, realized check cadence
+    "variant", "restart_scheme", "restarts", "cadence_final")
 
 
 def validate_solve_ledger(ledger: Dict) -> Dict:

@@ -18,19 +18,34 @@ Layout per grid step (VMEM ~16 MB/core on v5e):
     matmuls at ``precision=HIGHEST`` (bf16 multi-pass f32 — DEFAULT
     diverges, PERF.md "Solver precision").
 
-The kernel implements EXACTLY ``one_iter`` from ops/pdhg.py (same update,
-same projection), so the restart/convergence logic upstream is untouched;
-it plugs in through a ``jax.custom_batching.custom_vmap`` rule — the
+The kernel is VARIANT-NATIVE: it implements all three outer-iteration
+step variants from ops/pdhg.py (same update, same projections).
+``vanilla`` is EXACTLY ``one_iter``; ``reflected`` adds one elementwise
+relaxation ``z + a(T(z) - z)`` with re-projection (no extra operands, no
+extra VMEM); ``halpern`` additionally pulls toward the adaptive-restart
+anchor with the (k+1)/(k+2) schedule — the anchor only moves at restarts,
+i.e. BETWEEN chunks, so it rides as two chunk-constant blocked operands
+(plus the per-member inner count), which ``_block_vmem_bytes`` /
+``_banded_blk`` charge against the per-step VMEM envelope.  The
+restart/convergence logic upstream is untouched in every case; the
+kernel plugs in through ``jax.custom_batching.custom_vmap`` rules — the
 unbatched path keeps the reference ``lax.scan``.
+
+``DERVET_TPU_PALLAS_INTERPRET=1`` runs every ``pallas_call`` in
+INTERPRET mode (the kernel body executed as plain jax ops), which lifts
+the TPU-backend requirement in :func:`supports` — this is how CPU CI
+executes the REAL kernel for all three variants and asserts equivalence
+against the scan path without a chip (tests/test_pallas_interpret.py).
+Interpret mode is a correctness harness, not a performance path.
 """
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 
 # K must stay VMEM-resident next to the instance block; above this size
@@ -55,17 +70,51 @@ BLK = 128
 # the remote compile helper (VERDICT r3 #1).
 MAX_STEP_BYTES = 24 * 1024 * 1024
 
+# step-variant names, mirrored from ops/pdhg.py (string literals here to
+# keep this module importable without the circular pdhg import)
+_VANILLA = "vanilla"
+_REFLECTED = "reflected"
+_HALPERN = "halpern"
+_VARIANTS = (_VANILLA, _REFLECTED, _HALPERN)
 
-def _block_vmem_bytes(m: int, n: int, blk: int) -> int:
+# interpret-mode knob: run the kernel body as plain jax ops (any
+# backend) so CPU CI can execute and equivalence-test the real kernel
+INTERPRET_ENV = "DERVET_TPU_PALLAS_INTERPRET"
+
+
+def interpret_enabled() -> bool:
+    """Live read of the interpret-mode knob (consulted at trace time:
+    programs already compiled keep whatever mode they were built in)."""
+    return os.environ.get(INTERPRET_ENV, "").strip().lower() \
+        in ("1", "true", "on")
+
+
+def _block_vmem_bytes(m: int, n: int, blk: int,
+                      variant: str = _VANILLA) -> int:
     """Scoped-VMEM footprint of one grid step: K + the blocked operands
-    (7 x-space blocks incl. outputs, 5 y-space) that co-reside with it."""
-    return m * n * 4 + blk * (7 * n + 5 * m) * 4
+    (7 x-space blocks incl. outputs, 5 y-space) that co-reside with it.
+    The halpern variant adds the two anchor blocks + the (blk, 1) inner
+    count; reflected adds nothing (one elementwise relaxation in
+    registers)."""
+    words = 7 * n + 5 * m
+    if variant == _HALPERN:
+        words += n + m + 1
+    return m * n * 4 + blk * words * 4
 
 
-def _chunk_kernel(iters: int,
-                  c_ref, q_ref, l_ref, u_ref, tau_ref, sig_ref,
-                  x_ref, y_ref, xs_ref, ys_ref, k_ref, fl_ref,
-                  xo_ref, yo_ref, xso_ref, yso_ref):
+def _chunk_kernel(iters: int, variant: str, alpha: float, *refs):
+    if variant == _HALPERN:
+        (c_ref, q_ref, l_ref, u_ref, tau_ref, sig_ref,
+         x_ref, y_ref, xs_ref, ys_ref, k_ref, fl_ref,
+         k0_ref, ax_ref, ay_ref,
+         xo_ref, yo_ref, xso_ref, yso_ref) = refs
+        ax = ax_ref[...]             # (BLK, n) restart anchor (primal)
+        ay = ay_ref[...]             # (BLK, m) restart anchor (dual)
+        k0 = k0_ref[...]             # (BLK, 1) f32 inner count at entry
+    else:
+        (c_ref, q_ref, l_ref, u_ref, tau_ref, sig_ref,
+         x_ref, y_ref, xs_ref, ys_ref, k_ref, fl_ref,
+         xo_ref, yo_ref, xso_ref, yso_ref) = refs
     K = k_ref[...]                   # (m, n) scaled constraint matrix
     fl = fl_ref[...]                 # (1, m): -inf on eq rows, 0 on ge
     c = c_ref[...]
@@ -76,8 +125,8 @@ def _chunk_kernel(iters: int,
     sig = sig_ref[...]               # (BLK, 1) = eta * omega
     hi = jax.lax.Precision.HIGHEST
 
-    def it(_, carry):
-        x, y, xs, ys = carry
+    def T(x, y):
+        """One application of the PDHG operator (== pdhg.pdhg_step)."""
         # grad = c - K^T y   -> (BLK, m) @ (m, n)
         ky = jax.lax.dot_general(y, K, (((1,), (0,)), ((), ())),
                                  precision=hi,
@@ -88,10 +137,42 @@ def _chunk_kernel(iters: int,
                                  precision=hi,
                                  preferred_element_type=jnp.float32)
         y1 = jnp.maximum(y + sig * (q - kx), fl)
-        return x1, y1, xs + x1, ys + y1
+        return x1, y1
 
-    x, y, xs, ys = jax.lax.fori_loop(
-        0, iters, it, (x_ref[...], y_ref[...], xs_ref[...], ys_ref[...]))
+    if variant == _VANILLA:
+        def it(_, carry):
+            x, y, xs, ys = carry
+            x1, y1 = T(x, y)
+            return x1, y1, xs + x1, ys + y1
+
+        x, y, xs, ys = jax.lax.fori_loop(
+            0, iters, it, (x_ref[...], y_ref[...], xs_ref[...], ys_ref[...]))
+    elif variant == _REFLECTED:
+        def it(_, carry):
+            x, y, xs, ys = carry
+            xT, yT = T(x, y)
+            # relaxed iterate re-projected (mirrors one_iter_var: the
+            # relaxation may leave the box/cone)
+            x1 = jnp.clip(x + alpha * (xT - x), l, u)
+            y1 = jnp.maximum(y + alpha * (yT - y), fl)
+            return x1, y1, xs + x1, ys + y1
+
+        x, y, xs, ys = jax.lax.fori_loop(
+            0, iters, it, (x_ref[...], y_ref[...], xs_ref[...], ys_ref[...]))
+    else:                            # halpern
+        def it(_, carry):
+            x, y, xs, ys, kf = carry
+            xT, yT = T(x, y)
+            xR = x + alpha * (xT - x)
+            yR = y + alpha * (yT - y)
+            lam = (kf + 1.0) / (kf + 2.0)
+            x1 = jnp.clip(lam * xR + (1.0 - lam) * ax, l, u)
+            y1 = jnp.maximum(lam * yR + (1.0 - lam) * ay, fl)
+            return x1, y1, xs + x1, ys + y1, kf + 1.0
+
+        x, y, xs, ys, _ = jax.lax.fori_loop(
+            0, iters, it, (x_ref[...], y_ref[...], xs_ref[...],
+                           ys_ref[...], k0))
     xo_ref[...] = x
     yo_ref[...] = y
     xso_ref[...] = xs
@@ -99,21 +180,27 @@ def _chunk_kernel(iters: int,
 
 
 @functools.lru_cache(maxsize=32)
-def _build_call(m: int, n: int, iters: int, grid: int, blk: int):
+def _build_call(m: int, n: int, iters: int, grid: int, blk: int,
+                variant: str = _VANILLA, alpha: float = 1.0,
+                interp: bool = False):
     blk_x = pl.BlockSpec((blk, n), lambda i: (i, 0))
     blk_y = pl.BlockSpec((blk, m), lambda i: (i, 0))
     blk_s = pl.BlockSpec((blk, 1), lambda i: (i, 0))
     shared_k = pl.BlockSpec((m, n), lambda i: (0, 0))
     shared_f = pl.BlockSpec((1, m), lambda i: (0, 0))
+    in_specs = [blk_x, blk_y, blk_x, blk_x, blk_s, blk_s,
+                blk_x, blk_y, blk_x, blk_y, shared_k, shared_f]
+    if variant == _HALPERN:
+        # the chunk-constant restart anchors + per-member inner count
+        in_specs += [blk_s, blk_x, blk_y]
     # no CompilerParams scoped-VMEM override here: the ENCLOSING jit
     # raises the limit per-compile (pdhg.pallas_compiler_options), which
     # unlike Mosaic params or libtpu env flags also covers XLA's
     # promotion of the call's operands onto the scoped-VMEM stack
     return pl.pallas_call(
-        functools.partial(_chunk_kernel, iters),
+        functools.partial(_chunk_kernel, iters, variant, alpha),
         grid=(grid,),
-        in_specs=[blk_x, blk_y, blk_x, blk_x, blk_s, blk_s,
-                  blk_x, blk_y, blk_x, blk_y, shared_k, shared_f],
+        in_specs=in_specs,
         out_specs=[blk_x, blk_y, blk_x, blk_y],
         out_shape=[
             jax.ShapeDtypeStruct((grid * blk, n), jnp.float32),
@@ -121,17 +208,20 @@ def _build_call(m: int, n: int, iters: int, grid: int, blk: int):
             jax.ShapeDtypeStruct((grid * blk, n), jnp.float32),
             jax.ShapeDtypeStruct((grid * blk, m), jnp.float32),
         ],
+        interpret=interp,
     )
 
 
-def _banded_blk(op) -> Optional[int]:
+def _banded_blk(op, variant: str = _VANILLA) -> Optional[int]:
     """Instance-block size for the banded kernel, or None if unsupported.
 
     Unlike the dense kernel — MXU-bound, where a 64-row block half-fills
     the 128-wide systolic array and loses to the scan path — the banded
     kernel is VPU-elementwise, so a smaller block only shrinks VMEM
     footprint.  128 when it fits the per-step envelope, else 64 (lets
-    wide multi-DER windows like n≈6k on the kernel), else decline.
+    wide multi-DER windows like n≈6k on the kernel), else decline.  The
+    halpern variant's anchor blocks + inner count are charged per block
+    row, exactly like the dense accounting.
 
     A low-rank wide-row pair (daily-cycle aggregation rows) is supported
     — its (m, r) selector + (r, n) values are VMEM-resident next to the
@@ -144,15 +234,18 @@ def _banded_blk(op) -> Optional[int]:
     if op.wide_w is not None:
         r = op.wide_w.shape[0]
         wide_bytes = (op.m * r + r * op.n) * 4
+    words = 9 * op.n + 5 * op.m
+    if variant == _HALPERN:
+        words += op.n + op.m + 1
     for blk in (BLK, BLK // 2):
-        if nb * op.m * 4 + wide_bytes \
-                + blk * (9 * op.n + 5 * op.m) * 4 <= MAX_STEP_BYTES:
+        if nb * op.m * 4 + wide_bytes + blk * words * 4 <= MAX_STEP_BYTES:
             return blk
     return None
 
 
 def _banded_chunk_kernel(iters: int, offsets: tuple, m: int, n: int,
-                         has_wide: bool, *refs):
+                         has_wide: bool, variant: str, alpha: float,
+                         *refs):
     """Banded variant of ``_chunk_kernel``: the constraint matrix is a
     handful of diagonals (j - i = d), so both matvecs are static shifted
     slices + elementwise FMAs on the VPU — ~nb*m MACs per instance per
@@ -161,17 +254,23 @@ def _banded_chunk_kernel(iters: int, offsets: tuple, m: int, n: int,
     With ``has_wide``, a low-rank wide-row pair (K_wide = P @ W, the
     daily-cycle aggregation rows) adds two small MXU matmuls per
     direction.  Mirrors ops/pdhg.py::op_matvec/op_rmatvec for BandedOp
-    exactly."""
+    exactly; the step variants mirror ``one_iter``/``one_iter_var``."""
+    refs = list(refs)
+    (c_ref, q_ref, l_ref, u_ref, tau_ref, sig_ref,
+     x_ref, y_ref, xs_ref, ys_ref, d_ref, fl_ref) = refs[:12]
+    pos = 12
     if has_wide:
-        (c_ref, q_ref, l_ref, u_ref, tau_ref, sig_ref,
-         x_ref, y_ref, xs_ref, ys_ref, d_ref, fl_ref, p_ref, w_ref,
-         xo_ref, yo_ref, xso_ref, yso_ref) = refs
+        p_ref, w_ref = refs[pos:pos + 2]
+        pos += 2
         P = p_ref[...]               # (m, r) 0/1 row selector
         W = w_ref[...]               # (r, n) wide-row values
-    else:
-        (c_ref, q_ref, l_ref, u_ref, tau_ref, sig_ref,
-         x_ref, y_ref, xs_ref, ys_ref, d_ref, fl_ref,
-         xo_ref, yo_ref, xso_ref, yso_ref) = refs
+    if variant == _HALPERN:
+        k0_ref, ax_ref, ay_ref = refs[pos:pos + 3]
+        pos += 3
+        k0 = k0_ref[...]
+        ax = ax_ref[...]
+        ay = ay_ref[...]
+    xo_ref, yo_ref, xso_ref, yso_ref = refs[pos:pos + 4]
     diags = d_ref[...]               # (nb, m) band values
     fl = fl_ref[...]                 # (1, m): -inf on eq rows, 0 on ge
     c = c_ref[...]
@@ -222,14 +321,43 @@ def _banded_chunk_kernel(iters: int, offsets: tuple, m: int, n: int,
                 preferred_element_type=jnp.float32)
         return out
 
-    def it(_, carry):
-        x, y, xs, ys = carry
+    def T(x, y):
         x1 = jnp.clip(x - tau * (c - rmatvec(y)), l, u)
         y1 = jnp.maximum(y + sig * (q - matvec(2.0 * x1 - x)), fl)
-        return x1, y1, xs + x1, ys + y1
+        return x1, y1
 
-    x, y, xs, ys = jax.lax.fori_loop(
-        0, iters, it, (x_ref[...], y_ref[...], xs_ref[...], ys_ref[...]))
+    if variant == _VANILLA:
+        def it(_, carry):
+            x, y, xs, ys = carry
+            x1, y1 = T(x, y)
+            return x1, y1, xs + x1, ys + y1
+
+        x, y, xs, ys = jax.lax.fori_loop(
+            0, iters, it, (x_ref[...], y_ref[...], xs_ref[...], ys_ref[...]))
+    elif variant == _REFLECTED:
+        def it(_, carry):
+            x, y, xs, ys = carry
+            xT, yT = T(x, y)
+            x1 = jnp.clip(x + alpha * (xT - x), l, u)
+            y1 = jnp.maximum(y + alpha * (yT - y), fl)
+            return x1, y1, xs + x1, ys + y1
+
+        x, y, xs, ys = jax.lax.fori_loop(
+            0, iters, it, (x_ref[...], y_ref[...], xs_ref[...], ys_ref[...]))
+    else:                            # halpern
+        def it(_, carry):
+            x, y, xs, ys, kf = carry
+            xT, yT = T(x, y)
+            xR = x + alpha * (xT - x)
+            yR = y + alpha * (yT - y)
+            lam = (kf + 1.0) / (kf + 2.0)
+            x1 = jnp.clip(lam * xR + (1.0 - lam) * ax, l, u)
+            y1 = jnp.maximum(lam * yR + (1.0 - lam) * ay, fl)
+            return x1, y1, xs + x1, ys + y1, kf + 1.0
+
+        x, y, xs, ys, _ = jax.lax.fori_loop(
+            0, iters, it, (x_ref[...], y_ref[...], xs_ref[...],
+                           ys_ref[...], k0))
     xo_ref[...] = x
     yo_ref[...] = y
     xso_ref[...] = xs
@@ -238,7 +366,9 @@ def _banded_chunk_kernel(iters: int, offsets: tuple, m: int, n: int,
 
 @functools.lru_cache(maxsize=32)
 def _build_banded_call(m: int, n: int, nb: int, offsets: tuple, iters: int,
-                       grid: int, blk: int, r_wide: int = 0):
+                       grid: int, blk: int, r_wide: int = 0,
+                       variant: str = _VANILLA, alpha: float = 1.0,
+                       interp: bool = False):
     blk_x = pl.BlockSpec((blk, n), lambda i: (i, 0))
     blk_y = pl.BlockSpec((blk, m), lambda i: (i, 0))
     blk_s = pl.BlockSpec((blk, 1), lambda i: (i, 0))
@@ -249,9 +379,11 @@ def _build_banded_call(m: int, n: int, nb: int, offsets: tuple, iters: int,
     if r_wide:
         in_specs += [pl.BlockSpec((m, r_wide), lambda i: (0, 0)),
                      pl.BlockSpec((r_wide, n), lambda i: (0, 0))]
+    if variant == _HALPERN:
+        in_specs += [blk_s, blk_x, blk_y]
     return pl.pallas_call(
         functools.partial(_banded_chunk_kernel, iters, offsets, m, n,
-                          bool(r_wide)),
+                          bool(r_wide), variant, alpha),
         grid=(grid,),
         in_specs=in_specs,
         out_specs=[blk_x, blk_y, blk_x, blk_y],
@@ -261,6 +393,7 @@ def _build_banded_call(m: int, n: int, nb: int, offsets: tuple, iters: int,
             jax.ShapeDtypeStruct((grid * blk, n), jnp.float32),
             jax.ShapeDtypeStruct((grid * blk, m), jnp.float32),
         ],
+        interpret=interp,
     )
 
 
@@ -274,13 +407,22 @@ RUNTIME_DISABLED_REASON: Optional[str] = None
 
 
 def supports(op, dtype, precision=None, backend: Optional[str] = None,
-             ignore_runtime_disabled: bool = False) -> bool:
+             ignore_runtime_disabled: bool = False,
+             variant: str = _VANILLA) -> bool:
     """Static gate: dense op, f32 at HIGHEST precision, on a real TPU
-    backend, K + one operand block fits the per-grid-step VMEM envelope
-    (MAX_STEP_BYTES, measured on the remote-compile v5e — larger steps
-    crash the compile helper, not just fail gracefully).  The kernel
-    hardcodes HIGHEST matmuls (DEFAULT diverges, PERF.md), so any other
-    requested precision stays on the scan path, which honors it.
+    backend (or ANY backend under ``DERVET_TPU_PALLAS_INTERPRET=1`` —
+    interpret mode runs the kernel body as plain jax ops, the CPU-CI
+    equivalence harness), K + one operand block fits the per-grid-step
+    VMEM envelope (MAX_STEP_BYTES, measured on the remote-compile v5e —
+    larger steps crash the compile helper, not just fail gracefully).
+    The kernel hardcodes HIGHEST matmuls (DEFAULT diverges, PERF.md), so
+    any other requested precision stays on the scan path, which honors
+    it.
+
+    All three step variants are kernel-native; ``variant`` feeds the
+    VMEM accounting (halpern's anchors + inner count are two extra
+    blocked operands per grid step, so a shape that fits vanilla can
+    decline halpern).
 
     BandedOp is supported too (its own kernel, ``_banded_chunk_kernel``)
     when it has no residual ELL part — residual entries would need a
@@ -294,14 +436,18 @@ def supports(op, dtype, precision=None, backend: Optional[str] = None,
     from .pdhg import BandedOp, DenseOp
     if RUNTIME_DISABLED and not ignore_runtime_disabled:
         return False
+    if variant not in _VARIANTS:
+        return False
     if precision is not None and precision != jax.lax.Precision.HIGHEST:
         return False
     if backend is None:
         backend = jax.default_backend()
-    if backend != "tpu" or dtype != jnp.float32:
+    if backend != "tpu" and not interpret_enabled():
+        return False
+    if dtype != jnp.float32:
         return False
     if isinstance(op, BandedOp):
-        return _banded_blk(op) is not None
+        return _banded_blk(op, variant) is not None
     if not isinstance(op, DenseOp):
         return False
     mm, nn = op.Kh.shape
@@ -310,24 +456,30 @@ def supports(op, dtype, precision=None, backend: Optional[str] = None,
     # the blocked operands co-reside with K in scoped VMEM; a skewed
     # shape (huge n, tiny m) can blow the budget even with a small K —
     # decline it and let the scan path handle it
-    return _block_vmem_bytes(mm, nn, BLK) <= MAX_STEP_BYTES
+    return _block_vmem_bytes(mm, nn, BLK, variant) <= MAX_STEP_BYTES
 
 
 def batched_chunk(op, c, q, l, u, omega, eta, x, y, xs, ys,
-                  n_eq: int, iters: int):
+                  n_eq: int, iters: int, variant: str = _VANILLA,
+                  alpha: float = 1.0, k=None, ax=None, ay=None):
     """Run ``iters`` PDHG iterations for a whole batch via the fused
     kernel (dense or banded by op type).  All data args are (B, ·);
-    omega is (B,)."""
+    omega is (B,).  Non-vanilla variants take the relaxation weight
+    ``alpha``; halpern additionally takes the per-member inner count
+    ``k`` (B,) and the restart anchors ``ax`` (B, n) / ``ay`` (B, m) —
+    chunk-constant by construction (anchors only move at restarts,
+    between chunks)."""
     from .pdhg import BandedOp
 
     B = x.shape[0]
     banded = isinstance(op, BandedOp)
     m, n = (op.m, op.n) if banded else op.Kh.shape
-    blk = _banded_blk(op) if banded else BLK
+    blk = _banded_blk(op, variant) if banded else BLK
     assert blk is not None, \
         "batched_chunk called with a banded op that supports() declines"
     grid = -(-B // blk)
     pad = grid * blk - B
+    interp = interpret_enabled()
 
     def p(a):
         return jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1)) if pad else a
@@ -337,18 +489,27 @@ def batched_chunk(op, c, q, l, u, omega, eta, x, y, xs, ys,
     floor = jnp.where(jnp.arange(m) < n_eq, -jnp.inf, 0.0)[None, :] \
         .astype(jnp.float32)
     extra = ()
+    if variant == _HALPERN:
+        assert k is not None and ax is not None and ay is not None, \
+            "halpern batched_chunk needs the inner count and anchors"
+        halp = (p(k.astype(jnp.float32)[:, None]), p(ax), p(ay))
+    else:
+        halp = ()
     if banded:
         r_wide = 0 if op.wide_w is None else int(op.wide_w.shape[0])
         call = _build_banded_call(m, n, len(op.offsets), op.offsets,
-                                  iters, grid, blk, r_wide)
+                                  iters, grid, blk, r_wide, variant,
+                                  float(alpha), interp)
         mat = op.diags
         if r_wide:
             extra = (op.wide_p, op.wide_w)
     else:
-        call = _build_call(m, n, iters, grid, blk)
+        call = _build_call(m, n, iters, grid, blk, variant, float(alpha),
+                           interp)
         mat = op.Kh
     xo, yo, xso, yso = call(p(c), p(q), p(l), p(u), p(tau), p(sig),
-                            p(x), p(y), p(xs), p(ys), mat, floor, *extra)
+                            p(x), p(y), p(xs), p(ys), mat, floor,
+                            *extra, *halp)
     if pad:
         xo, yo, xso, yso = (a[:B] for a in (xo, yo, xso, yso))
     return xo, yo, xso, yso

@@ -173,6 +173,43 @@ def resolved_variant(opts: "PDHGOptions") -> str:
 
 
 # ---------------------------------------------------------------------------
+# Restart schemes.  'kkt' is the retained PDLP criterion: restart when the
+# weighted-average/current KKT score decays sufficiently or plateaus, and
+# restart TO the better of the two candidates.  'fixed_point' is the
+# Halpern-native criterion (MPAX, arxiv 2412.09734): watch the
+# fixed-point residual ‖T(z) - z‖ instead, restart when it stops decaying
+# geometrically, and restart TO the CURRENT iterate — under 'halpern' the
+# restart point is the anchor, and pulling the anchor onto the averaged
+# candidate (what the KKT scheme does) makes the anchor fight the
+# iterate, which is exactly why halpern standalone trailed reflected
+# before this scheme existed.  'auto' picks fixed_point for halpern and
+# kkt otherwise, per the RESOLVED variant — so the
+# DERVET_TPU_PDHG_VARIANT=vanilla kill path also restores the legacy
+# restart machinery bit for bit.
+# ---------------------------------------------------------------------------
+
+RESTART_KKT = "kkt"
+RESTART_FIXED_POINT = "fixed_point"
+RESTART_AUTO = "auto"
+RESTART_SCHEMES = (RESTART_KKT, RESTART_FIXED_POINT, RESTART_AUTO)
+
+
+def resolved_restart_scheme(opts: "PDHGOptions") -> str:
+    """The concrete restart criterion a solver built from ``opts`` runs
+    (``auto`` resolved against the resolved variant)."""
+    s = str(opts.restart_scheme).strip().lower()
+    if s not in RESTART_SCHEMES:
+        raise ValueError(
+            f"PDHGOptions.restart_scheme {opts.restart_scheme!r} is not "
+            f"one of {RESTART_SCHEMES}")
+    if s == RESTART_AUTO:
+        return (RESTART_FIXED_POINT
+                if resolved_variant(opts) == VARIANT_HALPERN
+                else RESTART_KKT)
+    return s
+
+
+# ---------------------------------------------------------------------------
 # Preconditioning (host-side, numpy — runs once per problem structure)
 # ---------------------------------------------------------------------------
 
@@ -578,6 +615,28 @@ class PDHGOptions:
     # a in (1, 2) — 2 is the pure reflection (needs Halpern anchoring
     # for guarantees), 1 degenerates to vanilla
     reflection_coeff: float = 1.8
+    # restart criterion (see resolved_restart_scheme): 'kkt' is the
+    # retained PDLP weighted-average schedule, 'fixed_point' the
+    # Halpern-native ‖T(z)-z‖ geometric-decay criterion that restarts
+    # to the CURRENT iterate (the anchor stops fighting the averaged
+    # candidate), 'auto' (default) maps halpern -> fixed_point and
+    # vanilla/reflected -> kkt.  Selectable per-variant: any
+    # combination is legal.
+    restart_scheme: str = RESTART_AUTO
+    # fixed_point-scheme sufficient-decay threshold (beta_sufficient's
+    # analogue on the FP residual): restart when ‖T(z)-z‖ has decayed
+    # to this fraction of its value at the last restart.  Halpern wants
+    # FREQUENT re-anchoring — 0.5 measured best at bench shapes
+    # (0.2/0.368 left 6-19% on the table; see PERF.md r15); the KKT
+    # scheme keeps its own beta_sufficient untouched.
+    fp_beta_sufficient: float = 0.5
+    # halpern relaxation weight UNDER THE fixed_point SCHEME ONLY: the
+    # anchored step composes best with the FULL reflection (a = 2, the
+    # r2HPDHG form — 1.8 was tuned against the KKT schedule's
+    # anchor-fighting and measured slower once the FP scheme landed).
+    # halpern+kkt keeps reflection_coeff (a = 2.0 measured worse
+    # there, PR 11); None inherits reflection_coeff everywhere.
+    halpern_coeff: Optional[float] = 2.0
     # restart scheme thresholds (simplified PDLP)
     beta_sufficient: float = 0.2
     beta_necessary: float = 0.8
@@ -704,6 +763,10 @@ class SolveStats:
     # fetch (the adaptive schedule's current value; == check_every once
     # saturated, 0 when no chunk ran)
     cadence_final: int = 0
+    # restart criterion the solver's compiled programs ran ('kkt' |
+    # 'fixed_point') — the solver-core ledger observable for the
+    # Halpern-native scheme
+    restart_scheme: str = ""
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -847,7 +910,19 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int, axis=None):
 
     prec = opts.precision
     variant = resolved_variant(opts)
+    # restart criterion (resolved_restart_scheme): the fixed-point
+    # scheme replaces the PDLP candidate machinery for the restart
+    # DECISION and TARGET only — convergence/infeasibility checks and
+    # the primal-weight update are shared, and with fp_scheme False the
+    # trace below is bit-identical to the legacy KKT path
+    fp_scheme = resolved_restart_scheme(opts) == RESTART_FIXED_POINT
     alpha = float(opts.reflection_coeff)
+    if variant == VARIANT_HALPERN and fp_scheme \
+            and opts.halpern_coeff is not None:
+        # scheme-scoped: the full reflection only composes with the
+        # FP-residual restarts; under the KKT schedule halpern keeps
+        # the PR-11 reflection_coeff (a=2.0 measured worse there)
+        alpha = float(opts.halpern_coeff)
     # adaptive check cadence (see PDHGOptions.check_every_min): the while
     # body advances `n_sub` compiled sub-blocks of `sub` iterations per
     # check, where n_sub follows the carried geometric schedule.  With
@@ -916,23 +991,25 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int, axis=None):
             (x, y, xs, ys), None, length=sub)
         return x1, y1, xs1, ys1
 
-    def _scan_chunk_var(op, c, q, l, u, omega, eta, carry, ax, ay):
-        """``sub`` variant iterations; the carry threads the Halpern
-        inner count k alongside the iterates."""
+    def _scan_chunk_var(op, c, q, l, u, omega, eta, x, y, xs, ys, k,
+                        ax, ay):
+        """``sub`` variant iterations via lax.scan; the carry threads
+        the Halpern inner count k alongside the iterates.  Flat
+        (x, y, xs, ys, k) signature so the custom_vmap rule below can
+        route the whole batch onto the fused kernel."""
         carry, _ = jax.lax.scan(
             functools.partial(one_iter_var, op=op, c=c, q=q, l=l, u=u,
                               eq_mask=_eq_mask(op), omega=omega, eta=eta,
                               ax=ax, ay=ay),
-            carry, None, length=sub)
+            (x, y, xs, ys, k), None, length=sub)
         return carry
 
-    if variant == VARIANT_VANILLA and axis is None and opts.pallas_chunk:
+    if axis is None and opts.pallas_chunk and variant == VARIANT_VANILLA:
         # batched solves swap the scan for the fused Pallas chunk kernel
         # (ops/pallas_chunk.py) via a custom vmap rule: HBM traffic on the
         # iterate carries drops ~sub-fold.  The kernel implements
-        # one_iter verbatim (the VANILLA step only — variants ride the
-        # scan path), so restarts/termination upstream are untouched;
-        # anything unsupported falls back to vmap-of-scan.
+        # one_iter verbatim, so restarts/termination upstream are
+        # untouched; anything unsupported falls back to vmap-of-scan.
         chunk_fn = jax.custom_batching.custom_vmap(_scan_chunk)
 
         @chunk_fn.def_vmap
@@ -953,8 +1030,42 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int, axis=None):
                 out = jax.vmap(_scan_chunk, in_axes=in_axes)(
                     op, c, q, l, u, omega, eta, x, y, xs, ys)
             return out, (True, True, True, True)
+        chunk_var_fn = _scan_chunk_var
+    elif axis is None and opts.pallas_chunk:
+        # VARIANT-NATIVE kernel path (reflected/halpern): the same VMEM
+        # layout plus one elementwise relaxation; halpern's restart
+        # anchors are chunk-constant (anchors only move at restarts,
+        # between chunks) and ride as two extra blocked operands with
+        # the per-member inner count.  The inner-count output is
+        # reconstructed as k + sub (the loop advances it by exactly one
+        # per iteration), so the kernel returns only the iterate state.
+        chunk_fn = _scan_chunk
+        chunk_var_fn = jax.custom_batching.custom_vmap(_scan_chunk_var)
+
+        @chunk_var_fn.def_vmap
+        def _chunk_var_vmap_rule(axis_size, in_batched, op, c, q, l, u,
+                                 omega, eta, x, y, xs, ys, k, ax, ay):
+            from . import pallas_chunk
+            op_batched = any(jax.tree.leaves(in_batched[0]))
+            plain = (not op_batched and all(in_batched[1:6])
+                     and not in_batched[6] and all(in_batched[7:]))
+            if plain and pallas_chunk.supports(op, opts.dtype,
+                                               opts.precision,
+                                               variant=variant):
+                xo, yo, xso, yso = pallas_chunk.batched_chunk(
+                    op, c, q, l, u, omega, eta, x, y, xs, ys,
+                    n_eq, sub, variant=variant, alpha=alpha,
+                    k=k, ax=ax, ay=ay)
+                out = (xo, yo, xso, yso, k + sub)
+            else:
+                in_axes = tuple(jax.tree.map(lambda b: 0 if b else None, ib)
+                                for ib in in_batched)
+                out = jax.vmap(_scan_chunk_var, in_axes=in_axes)(
+                    op, c, q, l, u, omega, eta, x, y, xs, ys, k, ax, ay)
+            return out, (True,) * 5
     else:
         chunk_fn = _scan_chunk
+        chunk_var_fn = _scan_chunk_var
 
     def advance(op, c, q, l, u, omega, eta, s: "_State", n_sub):
         """Run ``n_sub`` sub-blocks of ``sub`` iterations from state
@@ -974,13 +1085,13 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int, axis=None):
         carry = (s.x, s.y, s.x_sum, s.y_sum, s.inner)
         ax, ay = s.x_restart, s.y_restart
         if not adaptive:
-            carry = _scan_chunk_var(op, c, q, l, u, omega, eta, carry,
-                                    ax, ay)
+            carry = chunk_var_fn(op, c, q, l, u, omega, eta, *carry,
+                                 ax, ay)
         else:
             carry = jax.lax.fori_loop(
                 0, n_sub,
-                lambda _, cr: _scan_chunk_var(op, c, q, l, u, omega, eta,
-                                              cr, ax, ay),
+                lambda _, cr: chunk_var_fn(op, c, q, l, u, omega, eta,
+                                           *cr, ax, ay),
                 carry)
         return carry[:4]
 
@@ -1117,15 +1228,47 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int, axis=None):
             streak = jnp.where(cert, s.infeas_streak + 1, 0)
             infeasible = streak >= opts.infeas_checks
 
-            do_restart = (
-                (mu_cand <= opts.beta_sufficient * s.mu_restart)
-                | ((mu_cand <= opts.beta_necessary * s.mu_restart) & (mu_cand > s.mu_prev))
-                | (inner.astype(x.dtype)
-                   >= opts.artificial_restart_frac * total.astype(x.dtype))
-            )
+            artificial = (inner.astype(x.dtype)
+                          >= opts.artificial_restart_frac
+                          * total.astype(x.dtype))
+            if fp_scheme:
+                # Halpern-native criterion (MPAX): watch the FIXED-POINT
+                # residual ‖T(z) - z‖ of the CURRENT iterate — one extra
+                # application of T per check (two matvecs, same order as
+                # the KKT terms already computed here) — and restart when
+                # it decays sufficiently (re-anchor at the better point)
+                # or stops decaying geometrically (plateau: the anchor
+                # pull has gone stale).  The restart target is the
+                # current iterate itself, never the averaged candidate:
+                # under halpern the restart point IS the anchor, and
+                # anchoring to the average is what made the anchor fight
+                # the iterate (why halpern standalone trailed reflected).
+                xT, yT = pdhg_step(op, c_s, q_s, l_s, u_s, eq_mask,
+                                   s.omega, eta, x, y)
+                dxT = xT - x
+                dyT = yT - y
+                fp_res = jnp.sqrt(jnp.sum(dxT * dxT)
+                                  + _psum_if(jnp.sum(dyT * dyT), axis))
+                do_restart = (
+                    (fp_res <= opts.fp_beta_sufficient * s.mu_restart)
+                    | ((fp_res <= opts.beta_necessary * s.mu_restart)
+                       & (fp_res > s.mu_prev))
+                    | artificial
+                )
+                # under fp_scheme the mu_restart/mu_prev state fields
+                # carry FIXED-POINT residuals, not KKT scores
+                restart_x, restart_y, mu_track = x, y, fp_res
+            else:
+                do_restart = (
+                    (mu_cand <= opts.beta_sufficient * s.mu_restart)
+                    | ((mu_cand <= opts.beta_necessary * s.mu_restart)
+                       & (mu_cand > s.mu_prev))
+                    | artificial
+                )
+                restart_x, restart_y, mu_track = x_cand, y_cand, mu_cand
             # primal weight update on restart
-            dx = jnp.linalg.norm(x_cand - s.x_restart)
-            dy = _rnorm(y_cand - s.y_restart, axis)
+            dx = jnp.linalg.norm(restart_x - s.x_restart)
+            dy = _rnorm(restart_y - s.y_restart, axis)
             theta = opts.primal_weight_smoothing
             new_omega = jnp.where(
                 (dx > 1e-10) & (dy > 1e-10),
@@ -1135,8 +1278,8 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int, axis=None):
             # keep the weight near its problem-scaled initialization; the
             # movement-ratio estimate can collapse the dual step otherwise
             new_omega = jnp.clip(new_omega, omega_lo, omega_hi)
-            x_n = jnp.where(do_restart, x_cand, x)
-            y_n = jnp.where(do_restart, y_cand, y)
+            x_n = jnp.where(do_restart, restart_x, x)
+            y_n = jnp.where(do_restart, restart_y, y)
 
             newly = conv_now & ~s.converged
             return _State(
@@ -1146,10 +1289,10 @@ def _make_solver(opts: PDHGOptions, m: int, n: int, n_eq: int, axis=None):
                 inner=jnp.where(do_restart, 0, inner),
                 total=total,
                 omega=jnp.where(do_restart, new_omega, s.omega).astype(dtype),
-                x_restart=jnp.where(do_restart, x_cand, s.x_restart),
-                y_restart=jnp.where(do_restart, y_cand, s.y_restart),
-                mu_restart=jnp.where(do_restart, mu_cand, s.mu_restart),
-                mu_prev=mu_cand,
+                x_restart=jnp.where(do_restart, restart_x, s.x_restart),
+                y_restart=jnp.where(do_restart, restart_y, s.y_restart),
+                mu_restart=jnp.where(do_restart, mu_track, s.mu_restart),
+                mu_prev=mu_track,
                 converged=s.converged | conv_now,
                 done_x=jnp.where(newly, x_cand, s.done_x),
                 done_y=jnp.where(newly, y_cand, s.done_y),
@@ -1264,18 +1407,17 @@ def pallas_compiler_options(opts: "PDHGOptions", op=None):
     the fallback handler would rightly refuse to retry it."""
     if not opts.pallas_chunk or jax.default_backend() != "tpu":
         return None
-    # variants ride the scan path (the kernel implements the vanilla
-    # step), so their programs never embed the kernel — attaching the
-    # scoped-VMEM raise to them is exactly the expansion hazard below
-    if resolved_variant(opts) != VARIANT_VANILLA:
-        return None
     if op is not None:
         from . import pallas_chunk
         # consult the LIVE kill switch here (unlike the compile-failure
         # handlers): once the kernel is disabled, newly built jits trace
         # the scan path, and attaching the raise to a pure scan program
-        # is exactly the hazard described above
-        if not pallas_chunk.supports(op, opts.dtype, opts.precision):
+        # is exactly the hazard described above.  The variant feeds the
+        # VMEM accounting — all three step variants are kernel-native
+        # now, but halpern's anchor operands can push a shape off the
+        # kernel that vanilla/reflected still fit.
+        if not pallas_chunk.supports(op, opts.dtype, opts.precision,
+                                     variant=resolved_variant(opts)):
             return None
     return {"xla_tpu_scoped_vmem_limit_kib": "98304"}
 
@@ -1297,48 +1439,69 @@ def disable_pallas_runtime(e: Exception) -> None:
 
 KERNEL_PALLAS = "pallas_chunk"
 KERNEL_SCAN = "xla_scan"
-# fallback reasons the bench gate treats as a REGRESSION: the kernel was
-# eligible and wanted, and a runtime compile failure knocked it out
-KERNEL_REGRESSION_PREFIX = "runtime_disabled"
+
+# Machine-stable kernel fallback reasons (enums).  The ledger's
+# per-group record, its solve_ledger.kernel aggregation, and bench's
+# check_kernel_gate all key on EXACTLY these values — free-form text
+# (e.g. the first line of a compile failure) travels separately as the
+# DETAIL, never as the reason.  FALLBACK_RUNTIME_DISABLED is the one the
+# bench gate treats as a REGRESSION: the kernel was eligible and wanted,
+# and a runtime compile failure knocked it out.
+FALLBACK_SINGLE_INSTANCE = "single_instance"
+FALLBACK_RUNTIME_DISABLED = "runtime_disabled"
+FALLBACK_OPTION_DISABLED = "option_disabled"
+FALLBACK_BACKEND = "backend"
+FALLBACK_UNSUPPORTED_SHAPE = "unsupported_shape"
+KERNEL_FALLBACK_REASONS = (
+    FALLBACK_SINGLE_INSTANCE, FALLBACK_RUNTIME_DISABLED,
+    FALLBACK_OPTION_DISABLED, FALLBACK_BACKEND,
+    FALLBACK_UNSUPPORTED_SHAPE)
+# retained alias: older ledgers recorded 'runtime_disabled: <detail>'
+# free-form; the gate accepts both the enum and the legacy prefix
+KERNEL_REGRESSION_PREFIX = FALLBACK_RUNTIME_DISABLED
 
 
 def kernel_selection(solver: "CompiledLPSolver", batched: bool
-                     ) -> tuple[str, Optional[str]]:
+                     ) -> tuple[str, Optional[str], Optional[str]]:
     """Which chunk kernel this solver's next ``_drive`` would run, and —
-    when it is the scan path — why (the fallback reason).  Recorded per
-    group in the solve ledger (ROADMAP item 4): BENCH_r03 showed the
-    fused kernel silently falling back, and a selection that is not a
-    published observable cannot be gated."""
+    when it is the scan path — why, as ``(kernel, reason, detail)``:
+    ``reason`` is a machine-stable enum from KERNEL_FALLBACK_REASONS
+    (what the ledger aggregation and the bench gate match on), ``detail``
+    optional free-form context.  Recorded per group in the solve ledger
+    (ROADMAP item 4): BENCH_r03 showed the fused kernel silently falling
+    back, and a selection that is not a published observable cannot be
+    gated.
+
+    All three step variants are kernel-native (the variant feeds the
+    VMEM accounting via ``supports``), so a reflected/halpern solve on
+    TPU reports ``pallas_chunk`` — there is no per-variant expected
+    fallback anymore."""
     from . import pallas_chunk
-    if not batched:
-        return KERNEL_SCAN, "single-instance path (kernel is batch-only)"
-    # a non-vanilla step variant was never kernel-eligible — report it
-    # BEFORE the runtime kill switch so a concurrent vanilla group's
-    # compile failure is not mis-attributed to this group as a
-    # regression (the bench gate keys on the runtime_disabled prefix).
     # solver.variant is the BUILD-TIME capture: a live env flip must not
-    # make the record disagree with the compiled programs.
+    # make the record disagree with the compiled programs
     v = getattr(solver, "variant", None) or resolved_variant(solver.opts)
-    if v != VARIANT_VANILLA:
-        return KERNEL_SCAN, (f"variant {v!r} rides the scan path "
-                             "(the fused kernel implements vanilla)")
-    # runtime kill switch FIRST among the vanilla reasons: the fallback
-    # handler also flips solver.opts.pallas_chunk, and the regression
-    # must not be mis-attributed to a caller's option choice
+    if not batched:
+        return (KERNEL_SCAN, FALLBACK_SINGLE_INSTANCE,
+                "kernel is batch-only")
+    # runtime kill switch FIRST: the fallback handler also flips
+    # solver.opts.pallas_chunk, and the regression must not be
+    # mis-attributed to a caller's option choice
     if pallas_chunk.RUNTIME_DISABLED:
-        return KERNEL_SCAN, (
-            f"{KERNEL_REGRESSION_PREFIX}: "
-            f"{pallas_chunk.RUNTIME_DISABLED_REASON or 'compile failure'}")
+        return (KERNEL_SCAN, FALLBACK_RUNTIME_DISABLED,
+                pallas_chunk.RUNTIME_DISABLED_REASON or "compile failure")
     if not solver.opts.pallas_chunk:
-        return KERNEL_SCAN, "pallas_chunk disabled in solver options"
+        return (KERNEL_SCAN, FALLBACK_OPTION_DISABLED,
+                "pallas_chunk disabled in solver options")
     if not pallas_chunk.supports(solver.op, solver.opts.dtype,
-                                 solver.opts.precision):
+                                 solver.opts.precision, variant=v):
         backend = jax.default_backend()
-        if backend != "tpu":
-            return KERNEL_SCAN, f"backend {backend!r} (kernel is TPU-only)"
-        return KERNEL_SCAN, \
-            "unsupported shape/dtype/precision for the fused kernel"
-    return KERNEL_PALLAS, None
+        if backend != "tpu" and not pallas_chunk.interpret_enabled():
+            return (KERNEL_SCAN, FALLBACK_BACKEND,
+                    f"backend {backend!r} (kernel is TPU-only; "
+                    f"{pallas_chunk.INTERPRET_ENV}=1 lifts this)")
+        return (KERNEL_SCAN, FALLBACK_UNSUPPORTED_SHAPE,
+                f"shape/dtype/precision unsupported under variant {v!r}")
+    return KERNEL_PALLAS, None, None
 
 
 class CompiledLPSolver:
@@ -1427,11 +1590,12 @@ class CompiledLPSolver:
 
     def _make_jits(self) -> None:
         lp = self.lp
-        # capture the variant the jits BAKE IN: resolved_variant consults
-        # the env kill switch live, but a mid-incident env flip only
-        # reaches rebuilt jits — observables must report what this
+        # capture the variant/scheme the jits BAKE IN: resolved_variant
+        # consults the env kill switch live, but a mid-incident env flip
+        # only reaches rebuilt jits — observables must report what this
         # solver's compiled programs actually run, not the current env
         self.variant = resolved_variant(self.opts)
+        self.restart_scheme = resolved_restart_scheme(self.opts)
         self._solve = _make_solver(self.opts, lp.m, lp.n, lp.n_eq)
         data_axes = (None, 0, 0, 0, 0, None, None)
         self._jit_init = jax.jit(self._solve.init_state)
@@ -1628,11 +1792,11 @@ class CompiledLPSolver:
                 # before a concurrent thread may have flipped the kill
                 # switch
                 kernel_in_play = (self.opts.pallas_chunk and batched
-                                  and self.variant == VARIANT_VANILLA
                                   and pallas_chunk.supports(
                                       self.op, self.opts.dtype,
                                       self.opts.precision,
-                                      ignore_runtime_disabled=True))
+                                      ignore_runtime_disabled=True,
+                                      variant=self.variant))
                 if not (kernel_in_play and is_pallas_compile_failure(e)):
                     raise
                 disable_pallas_runtime(e)
@@ -1656,6 +1820,8 @@ class CompiledLPSolver:
         program; everything downstream is seed-agnostic."""
         chunk = self._jit_chunk_b if batched else self._jit_chunk
         fin = self._jit_fin_b if batched else self._jit_fin
+        if stats is not None:
+            stats.restart_scheme = self.restart_scheme
         args = (self.op, c, q, l, u, self.dr, self.dc)
         if x0 is not None:
             self._note_exec("init_seeded", c.shape, stats)

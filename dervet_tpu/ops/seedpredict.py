@@ -17,12 +17,15 @@ that gap, and this codebase already has everything such a model needs:
   fault drill covers exactly the corrupted-prediction shape).
 
 The model is deliberately cheap: one RIDGE REGRESSION per structure key
-from the (d+1)-dimensional quantized feature vector (d = 4 x
-``FEATURE_BUCKETS`` bucketed means + bias) to the stacked ``[x; y]``
-iterate, solved by normal equations on the host — microseconds to fit at
-d ~ 33, independent of how large ``n + m`` is (the Gram matrix is
-feature-sized; the target projection is one (N, d+1)^T @ (N, n+m)
-matmul over at most a few hundred memory entries).  Below
+from the (d+1)-dimensional quantized feature vector (d =
+``warmstart.FEATURE_DIM``: 4 x ``FEATURE_BUCKETS`` bucketed means plus
+the per-window price quantiles and SOE boundary state, + bias) to the
+stacked ``[x; y]`` iterate, solved by normal equations on the host —
+microseconds to fit at d ~ 41, independent of how large ``n + m`` is
+(the Gram matrix is feature-sized; the target projection is one
+(N, d+1)^T @ (N, n+m) matmul over at most a few hundred memory
+entries).  Models fitted under an older feature dimension are dropped
+on fleet import (``import_models``) and skipped at fit time.  Below
 ``min_entries`` the model abstains and the planner falls back to the
 nearest-feature near grade; a certificate rejection on a structure drops
 its model outright (``invalidate``).
@@ -138,9 +141,21 @@ class SeedPredictor:
                     len(entries) < model.trained_on + self.refit_every:
                 return model
         n, m = entries[-1].x.shape[0], entries[-1].y.shape[0]
+        # the reference feature layout is the CURRENT one
+        # (warmstart.FEATURE_DIM, lazy import — warmstart imports this
+        # module): entries stored under an OLDER feature dimension
+        # (fleet imports from a pre-feature-bump replica) are skipped
+        # exactly like shape-mismatched iterates, even when such an
+        # entry happens to be the newest in the pool — anchoring on
+        # entries[-1] would let one old-dim import invert the skip and
+        # replace a healthy model with one predict() must then refuse
+        from . import warmstart as _ws
+        d_ref = _ws.FEATURE_DIM
         feats, targets = [], []
         for e in entries:
             if e.x.shape[0] != n or e.y.shape[0] != m:
+                continue
+            if np.asarray(e.feature).shape[0] != d_ref:
                 continue
             xy = np.concatenate([np.asarray(e.x, np.float64),
                                  np.asarray(e.y, np.float64)])
@@ -225,7 +240,12 @@ class SeedPredictor:
     def import_models(self, payload) -> int:
         """Install another replica's exported models.  Existing local
         models win (they were trained on locally-verified solves);
-        malformed records are skipped.  Returns the number installed."""
+        malformed records are skipped, and models fitted under an OLDER
+        feature dimension are DROPPED on load (a pre-feature-bump
+        replica's model would silently mis-predict against the current
+        feature layout — ``predict`` would abstain anyway, so keeping
+        them only wastes LRU slots).  Returns the number installed."""
+        from . import warmstart as _ws
         n_in = 0
         for k, f in payload or ():
             try:
@@ -235,6 +255,8 @@ class SeedPredictor:
                 if W.ndim != 2 or W.shape[1] != mdl.n + mdl.m \
                         or not np.all(np.isfinite(W)):
                     continue
+                if mdl.feat_dim != _ws.FEATURE_DIM:
+                    continue        # old-dim model: dropped on load
                 key = k     # structure keys pickle round-trip as-is
             except (KeyError, TypeError, ValueError, IndexError):
                 continue

@@ -60,6 +60,17 @@ DEFAULT_CAP = 512
 # feature vector: bucketed means per data vector — coarse but cheap, and
 # only consulted when the quantized digest misses
 FEATURE_BUCKETS = 8
+# richer cold-start features (r15): the bucketed means saturate in the
+# noise regime where the price LEVEL is stable but the hourly SHAPE
+# moves (1%-per-hour noise: 1.4x vs the 2.2x resubmission-grade figure)
+# — per-window price quantiles capture the shape's spread independent of
+# hour alignment, and the SOE boundary state (the rhs of the soe
+# recurrence/seam rows: entry SOE and final target) pins the feature the
+# dispatch basis actually pivots on.  Both append to the same float16-
+# quantized digest the predictor trains on.
+PRICE_QUANTILES = (0.05, 0.25, 0.5, 0.75, 0.95)
+N_SOE_FEATURES = 4
+FEATURE_DIM = 4 * FEATURE_BUCKETS + len(PRICE_QUANTILES) + N_SOE_FEATURES
 
 
 def enabled() -> bool:
@@ -119,9 +130,13 @@ def quant_digest(lp) -> bytes:
 
 
 def feature_vec(lp) -> np.ndarray:
-    """Small signature of ``(c, q, l, u)`` for nearest-entry selection:
-    ``FEATURE_BUCKETS`` contiguous-bucket means per vector (non-finite
-    entries zeroed — sentinels would drown the signal)."""
+    """Small signature of ``(c, q, l, u)`` for nearest-entry selection
+    and predictor training — ``FEATURE_DIM`` long: ``FEATURE_BUCKETS``
+    contiguous-bucket means per vector (non-finite entries zeroed —
+    sentinels would drown the signal), the per-window PRICE QUANTILES of
+    the finite objective entries, and the SOE BOUNDARY STATE read from
+    the rhs of the ``soe``-named row groups (entry SOE / final-target
+    pins — the numbers the dispatch basis pivots on)."""
     parts = []
     for a in (lp.c, lp.q, lp.l, lp.u):
         a = np.asarray(a, np.float64)
@@ -134,7 +149,46 @@ def feature_vec(lp) -> np.ndarray:
         if pad:
             a = np.concatenate([a, np.zeros(pad)])
         parts.append(a.reshape(FEATURE_BUCKETS, -1).mean(axis=1))
+    # per-window price quantiles: the objective vector IS the price
+    # signal in dispatch LPs (charge cost / discharge revenue per step)
+    c = np.asarray(lp.c, np.float64)
+    c_fin = c[np.isfinite(c)]
+    parts.append(np.quantile(c_fin, PRICE_QUANTILES) if c_fin.size
+                 else np.zeros(len(PRICE_QUANTILES)))
+    # SOE boundary state: first/last rhs entry of every soe-named row
+    # range (the entry-SOE carry and the window's final target/seam pin)
+    firsts, lasts = [], []
+    q = np.asarray(lp.q, np.float64)
+    for name, ranges in (getattr(lp, "row_groups", None) or {}).items():
+        if "soe" not in str(name).lower():
+            continue
+        for a0, b0 in ranges:
+            if b0 > a0 and b0 <= q.shape[0]:
+                firsts.append(q[a0])
+                lasts.append(q[b0 - 1])
+    if firsts:
+        bvals = np.asarray(firsts + lasts, np.float64)
+        bvals = np.where(np.isfinite(bvals), bvals, 0.0)
+        soe_feat = np.array([
+            float(np.mean(bvals[:len(firsts)])),
+            float(np.mean(bvals[len(firsts):])),
+            float(np.max(np.abs(bvals))),
+            float(len(firsts)),
+        ])
+    else:
+        soe_feat = np.zeros(N_SOE_FEATURES)
+    parts.append(soe_feat)
     return np.concatenate(parts)
+
+
+def _feat_dist(a: np.ndarray, b: np.ndarray) -> float:
+    """L2 distance between feature vectors, inf on a dimension mismatch
+    — entries stored under an OLDER feature layout (a fleet import from
+    a pre-bump replica) must lose every nearest-feature contest rather
+    than crash it."""
+    if a.shape != b.shape:
+        return float("inf")
+    return float(np.linalg.norm(a - b))
 
 
 def host_kkt(lp, x, y) -> Optional[Tuple[float, float, float,
@@ -323,11 +377,11 @@ class SolutionMemory:
             if pool:
                 f = feature_vec(lp)
                 best_key = min(
-                    pool, key=lambda k: float(
-                        np.linalg.norm(pool[k].feature - f)))
-                self._entries.move_to_end(best_key)
-                self.stats["hits_near"] += 1
-                return pool[best_key], "feature", exact, quant
+                    pool, key=lambda k: _feat_dist(pool[k].feature, f))
+                if np.isfinite(_feat_dist(pool[best_key].feature, f)):
+                    self._entries.move_to_end(best_key)
+                    self.stats["hits_near"] += 1
+                    return pool[best_key], "feature", exact, quant
             self.stats["misses"] += 1
             return None, None, exact, quant
 

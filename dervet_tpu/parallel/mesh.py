@@ -163,13 +163,12 @@ def solve_batch_sharded(solver: CompiledLPSolver, mesh: Mesh,
                                               stats, x0=x0, y0=y0)
         except Exception as e:
             from ..ops import pallas_chunk
-            from ..ops.pdhg import VARIANT_VANILLA
             kernel_in_play = (solver.opts.pallas_chunk
-                              and solver.variant == VARIANT_VANILLA
                               and pallas_chunk.supports(
                                   solver.op, solver.opts.dtype,
                                   solver.opts.precision,
-                                  ignore_runtime_disabled=True))
+                                  ignore_runtime_disabled=True,
+                                  variant=solver.variant))
             if not (kernel_in_play and is_pallas_compile_failure(e)):
                 raise
             disable_pallas_runtime(e)

@@ -1600,7 +1600,7 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
     if ledger is not None:
         it = iters_m
         from ..ops.pdhg import kernel_selection, resolved_variant
-        kern, kern_why = kernel_selection(
+        kern, kern_why, kern_detail = kernel_selection(
             solver, batched=not (len(lps_dev) == 1 and pad_to is None))
         entry = {**(ledger_meta or {}),
                  "backend": backend, "m": lp0.m, "n": lp0.n,
@@ -1612,14 +1612,21 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
                  # under 'halpern'), and the realized check cadence
                  "variant": (getattr(solver, "variant", None)
                              or resolved_variant(solver.opts)),
+                 # restart criterion the group's programs baked in
+                 # ('kkt' | 'fixed_point' — the Halpern-native scheme)
+                 "restart_scheme": getattr(solver, "restart_scheme", ""),
                  "restarts": int(rst_m.sum()),
                  "restarts_p50": int(np.percentile(rst_m, 50)),
                  "cadence_final": int(stats.cadence_final),
                  # chosen chunk kernel + fallback reason (ROADMAP item 4:
                  # BENCH_r03's silent scan fallback becomes a measured,
-                 # gateable observable)
+                 # gateable observable).  The reason is a MACHINE-STABLE
+                 # enum (pdhg.KERNEL_FALLBACK_REASONS); free-form context
+                 # rides separately as the detail.
                  "kernel": kern,
                  **({"kernel_fallback": kern_why} if kern_why else {}),
+                 **({"kernel_fallback_detail": kern_detail}
+                    if kern_detail else {}),
                  # single-window groups ride solver.solve even on a
                  # multi-device mesh — only real batches shard
                  "sharded": bool(multi_dev and len(lps_dev) > 1),
@@ -2481,6 +2488,7 @@ def summarize_solve_ledger(entries, dispatch_solve_s: float,
     # 'halpern'), and the realized check cadences
     from collections import Counter as _Counter
     core_variants: "_Counter" = _Counter()
+    core_schemes: "_Counter" = _Counter()
     core_restarts = 0
     core_anchor_resets = 0
     core_cadences: list = []
@@ -2512,6 +2520,8 @@ def summarize_solve_ledger(entries, dispatch_solve_s: float,
             e["other_s"] = round(max(0.0, e.get("solve_s", 0.0) - known), 4)
         if e.get("variant"):
             core_variants[e["variant"]] += 1
+            if e.get("restart_scheme"):
+                core_schemes[e["restart_scheme"]] += 1
             core_restarts += int(e.get("restarts") or 0)
             if e["variant"] == "halpern":
                 core_anchor_resets += int(e.get("restarts") or 0)
@@ -2567,6 +2577,9 @@ def summarize_solve_ledger(entries, dispatch_solve_s: float,
         # and the realized adaptive check cadence across groups
         out["solver_core"] = {
             "variants": dict(core_variants),
+            # restart-criterion mix (the Halpern-native fixed_point
+            # scheme vs the retained PDLP kkt schedule)
+            "restart_schemes": dict(core_schemes),
             "restarts": int(core_restarts),
             "anchor_resets": int(core_anchor_resets),
             "cadence_final_max": (max(core_cadences)

@@ -43,7 +43,11 @@ import numpy as np
 
 
 def variant_stage(batch: int) -> dict:
-    """Median cold-iteration reduction, vanilla -> default variant."""
+    """Median cold-iteration reduction, vanilla -> default variant,
+    plus the halpern-native restart drill: under its fixed-point-
+    residual schedule halpern must actually RESTART (anchor resets > 0)
+    and land within 15% of reflected median cold iterations — the gap
+    the PDLP weighted-average schedule left open (PR 11)."""
     from dervet_tpu.benchlib import build_window_lps, synthetic_case
     from dervet_tpu.ops.pdhg import (CompiledLPSolver, PDHGOptions,
                                      resolved_variant)
@@ -57,8 +61,10 @@ def variant_stage(batch: int) -> dict:
 
     out = {}
     for label, opts in (("vanilla", PDHGOptions(variant="vanilla")),
-                        ("variant", PDHGOptions())):
-        res = CompiledLPSolver(lp0, opts).solve(c=C)
+                        ("variant", PDHGOptions()),
+                        ("halpern", PDHGOptions(variant="halpern"))):
+        solver = CompiledLPSolver(lp0, opts)
+        res = solver.solve(c=C)
         it = np.asarray(res.iters)
         conv = int(np.asarray(res.converged).sum())
         if conv != batch:
@@ -67,6 +73,7 @@ def variant_stage(batch: int) -> dict:
         out[label] = {"iters_p50": int(np.percentile(it, 50)),
                       "iters_p99": int(np.percentile(it, 99)),
                       "variant": resolved_variant(opts),
+                      "restart_scheme": solver.restart_scheme,
                       "restarts": int(np.asarray(res.restarts).sum())}
     red = 1.0 - out["variant"]["iters_p50"] / out["vanilla"]["iters_p50"]
     out["reduction"] = round(red, 4)
@@ -76,6 +83,24 @@ def variant_stage(batch: int) -> dict:
             f"(vanilla p50 {out['vanilla']['iters_p50']}, "
             f"{out['variant']['variant']} p50 "
             f"{out['variant']['iters_p50']})")
+    # the halpern-native FP-residual restart criterion must ENGAGE
+    # (restarts recorded under the fixed_point scheme)...
+    if out["halpern"]["restart_scheme"] != "fixed_point":
+        raise AssertionError(
+            "halpern did not resolve to the fixed_point restart scheme: "
+            f"{out['halpern']}")
+    if out["halpern"]["restarts"] <= 0:
+        raise AssertionError(
+            f"halpern FP-residual restarts never engaged: {out['halpern']}")
+    # ...and close halpern's standalone gap to within 15% of reflected
+    ratio = out["halpern"]["iters_p50"] / max(out["variant"]["iters_p50"],
+                                              1)
+    out["halpern_vs_reflected"] = round(ratio, 4)
+    if ratio > 1.15:
+        raise AssertionError(
+            f"halpern standalone p50 {out['halpern']['iters_p50']} is "
+            f"{ratio:.2f}x reflected's {out['variant']['iters_p50']} "
+            "(> 1.15x): the FP-residual schedule is not closing the gap")
     return out
 
 
@@ -138,6 +163,9 @@ def service_stage(n_cases: int, months: int) -> dict:
     core = warm_led.get("solver_core") or {}
     if not core.get("variants"):
         raise AssertionError(f"no solver_core section in ledger: {core}")
+    if not core.get("restart_schemes"):
+        raise AssertionError(
+            f"no restart_schemes mix in the ledger solver_core: {core}")
 
     fault_warm = fault_led.get("warm_start") or {}
     if not fault_warm.get("stale_seed_faults"):

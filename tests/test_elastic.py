@@ -209,13 +209,23 @@ class TestElasticLedger:
         assert kern["runtime_disabled"] is False
 
     def test_kernel_fallback_gate(self):
-        """bench.check_kernel_gate: expected scan reasons pass, a
-        runtime_disabled reason fails the leg."""
+        """bench.check_kernel_gate: expected scan reasons (the enums)
+        pass, the FALLBACK_RUNTIME_DISABLED enum fails the leg, and the
+        legacy free-form 'runtime_disabled: <detail>' prefix from older
+        ledgers still fails it."""
         import bench
+        from dervet_tpu.ops.pdhg import (FALLBACK_BACKEND,
+                                         FALLBACK_RUNTIME_DISABLED,
+                                         FALLBACK_UNSUPPORTED_SHAPE)
         bench.check_kernel_gate(None, "t")
         bench.check_kernel_gate(
             {"kernel": {"fallback_reasons":
-                        {"backend 'cpu' (kernel is TPU-only)": 3}}}, "t")
+                        {FALLBACK_BACKEND: 3,
+                         FALLBACK_UNSUPPORTED_SHAPE: 1}}}, "t")
+        with pytest.raises(SystemExit):
+            bench.check_kernel_gate(
+                {"kernel": {"fallback_reasons":
+                            {FALLBACK_RUNTIME_DISABLED: 1}}}, "t")
         with pytest.raises(SystemExit):
             bench.check_kernel_gate(
                 {"kernel": {"fallback_reasons":

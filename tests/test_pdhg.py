@@ -680,16 +680,117 @@ class TestStepVariants:
         with pytest.raises(ValueError, match="variant"):
             resolved_variant(PDHGOptions(variant="bogus"))
 
-    def test_variant_rides_scan_kernel_reason(self):
-        """kernel_selection must report a non-vanilla variant as an
-        EXPECTED scan reason, never the runtime_disabled regression
-        prefix the bench gate fails on."""
-        from dervet_tpu.ops.pdhg import kernel_selection
+    def test_variant_kernel_selection_enum(self, monkeypatch):
+        """Post-variant-native-kernel regression: a variant solve must
+        never emit a variant-specific fallback reason — the kernel
+        implements all three steps.  Off-TPU without interpret mode the
+        reason is the machine-stable FALLBACK_BACKEND enum; under
+        interpret mode the kernel is selected outright."""
+        from dervet_tpu.ops import pallas_chunk
+        from dervet_tpu.ops.pdhg import (FALLBACK_BACKEND, KERNEL_PALLAS,
+                                         KERNEL_FALLBACK_REASONS,
+                                         kernel_selection)
         lp = battery_like_lp(T=48)
+        monkeypatch.delenv(pallas_chunk.INTERPRET_ENV, raising=False)
         solver = CompiledLPSolver(lp, PDHGOptions(variant="reflected"))
-        kern, why = kernel_selection(solver, batched=True)
-        assert kern == "xla_scan"
-        assert "variant" in why and not why.startswith("runtime_disabled")
+        kern, why, detail = kernel_selection(solver, batched=True)
+        if jax.default_backend() == "tpu":
+            assert kern == KERNEL_PALLAS and why is None
+        else:
+            assert kern == "xla_scan"
+            assert why == FALLBACK_BACKEND
+            assert why in KERNEL_FALLBACK_REASONS
+            assert "variant" not in why
+        monkeypatch.setenv(pallas_chunk.INTERPRET_ENV, "1")
+        solver2 = CompiledLPSolver(lp, PDHGOptions(variant="halpern"))
+        kern2, why2, _ = kernel_selection(solver2, batched=True)
+        assert kern2 == KERNEL_PALLAS and why2 is None
+
+    def test_fallback_reasons_are_machine_stable(self):
+        """Every reason kernel_selection can emit is a member of
+        KERNEL_FALLBACK_REASONS (the enum the ledger aggregation and
+        bench.check_kernel_gate match on)."""
+        from dervet_tpu.ops.pdhg import (KERNEL_FALLBACK_REASONS,
+                                         kernel_selection)
+        lp = battery_like_lp(T=48)
+        solver = CompiledLPSolver(lp, PDHGOptions(pallas_chunk=False))
+        kern, why, _ = kernel_selection(solver, batched=True)
+        assert why in KERNEL_FALLBACK_REASONS
+        kern, why, _ = kernel_selection(solver, batched=False)
+        assert why in KERNEL_FALLBACK_REASONS
+
+
+class TestRestartSchemes:
+    """The Halpern-native fixed-point-residual restart criterion
+    (restart_scheme='fixed_point', MPAX): restart when ‖T(z) - z‖ stops
+    decaying geometrically, re-anchoring at the CURRENT iterate — the
+    scheme that stops the halpern anchor from fighting the PDLP
+    weighted-average schedule."""
+
+    def test_auto_mapping(self):
+        from dervet_tpu.ops.pdhg import resolved_restart_scheme
+        assert resolved_restart_scheme(
+            PDHGOptions(variant="halpern")) == "fixed_point"
+        assert resolved_restart_scheme(
+            PDHGOptions(variant="reflected")) == "kkt"
+        assert resolved_restart_scheme(
+            PDHGOptions(variant="vanilla")) == "kkt"
+        # selectable per-variant: any explicit combination is legal
+        assert resolved_restart_scheme(PDHGOptions(
+            variant="reflected",
+            restart_scheme="fixed_point")) == "fixed_point"
+        assert resolved_restart_scheme(PDHGOptions(
+            variant="halpern", restart_scheme="kkt")) == "kkt"
+        with pytest.raises(ValueError, match="restart_scheme"):
+            resolved_restart_scheme(PDHGOptions(restart_scheme="bogus"))
+
+    def test_kill_switch_resolves_scheme_too(self, monkeypatch):
+        """DERVET_TPU_PDHG_VARIANT=vanilla on a halpern-configured
+        solver must restore the KKT scheme (auto follows the RESOLVED
+        variant) — part of the bit-exact kill path."""
+        from dervet_tpu.ops.pdhg import resolved_restart_scheme
+        monkeypatch.setenv("DERVET_TPU_PDHG_VARIANT", "vanilla")
+        assert resolved_restart_scheme(
+            PDHGOptions(variant="halpern")) == "kkt"
+
+    def test_halpern_fp_restarts_engage(self):
+        lp = battery_like_lp(T=96)
+        solver = CompiledLPSolver(lp, PDHGOptions(variant="halpern"))
+        assert solver.restart_scheme == "fixed_point"
+        res = solver.solve(c=np.stack([lp.c, lp.c * 1.02]))
+        assert bool(np.asarray(res.converged).all())
+        assert int(np.asarray(res.restarts).min()) > 0
+        assert solver.last_stats.restart_scheme == "fixed_point"
+
+    def test_halpern_fp_closes_on_reflected(self):
+        """The acceptance shape, small: halpern under its native scheme
+        lands within 15% of reflected median cold iterations (it
+        trailed badly under the KKT schedule)."""
+        lp = battery_like_lp(T=96)
+        C = np.stack([lp.c * (1 + 0.01 * i) for i in range(4)])
+        it = {}
+        for v in ("reflected", "halpern"):
+            res = CompiledLPSolver(lp, PDHGOptions(variant=v)).solve(c=C)
+            assert bool(np.asarray(res.converged).all())
+            it[v] = float(np.percentile(np.asarray(res.iters), 50))
+        assert it["halpern"] <= 1.15 * it["reflected"], it
+
+    def test_explicit_kkt_is_default_trace_for_vanilla(self):
+        """restart_scheme='kkt' spelled out reproduces the default
+        (auto) vanilla solve bit for bit — the legacy path is the same
+        trace, not a near-copy."""
+        lp = battery_like_lp(T=48)
+        a = CompiledLPSolver(lp, PDHGOptions(variant="vanilla")).solve()
+        b = CompiledLPSolver(lp, PDHGOptions(
+            variant="vanilla", restart_scheme="kkt")).solve()
+        assert np.array_equal(np.asarray(a.x), np.asarray(b.x))
+        assert int(a.iters) == int(b.iters)
+
+    def test_fp_scheme_on_reflected_converges(self):
+        lp = battery_like_lp(T=48)
+        res = CompiledLPSolver(lp, PDHGOptions(
+            variant="reflected", restart_scheme="fixed_point")).solve()
+        assert bool(res.converged)
 
 
 class TestAdaptiveCadence:

@@ -185,3 +185,104 @@ class TestCorruptedPrediction:
         res = solver.solve(c=lpq.c, x0=plans[0].entry.x,
                            y0=plans[0].entry.y)
         assert bool(res.converged)      # a bad seed never breaks a solve
+
+
+class TestRicherFeatures:
+    """Feature-dim bump (r15): per-window price quantiles + SOE boundary
+    state appended to the float16 feature digest — refit-compatible, and
+    old-dim models/entries degrade gracefully instead of crashing."""
+
+    def test_feature_vec_dim_and_layout(self):
+        lp = _arb_lp()
+        f = warmstart.feature_vec(lp)
+        assert f.shape == (warmstart.FEATURE_DIM,)
+        assert warmstart.FEATURE_DIM == (
+            4 * warmstart.FEATURE_BUCKETS
+            + len(warmstart.PRICE_QUANTILES) + warmstart.N_SOE_FEATURES)
+        # the SOE block reads the soe-named row group's boundary rhs:
+        # entry SOE 500 at the first soe row, final rhs 0
+        soe = f[-warmstart.N_SOE_FEATURES:]
+        assert soe[0] == pytest.approx(500.0)     # mean of first-row rhs
+        assert soe[1] == pytest.approx(0.0)       # mean of last-row rhs
+        assert soe[2] == pytest.approx(500.0)     # max |boundary|
+        assert soe[3] == pytest.approx(1.0)       # one soe range
+
+    def test_price_quantiles_see_shape_not_just_level(self):
+        """Two price vectors with the same bucketed means but different
+        spread must produce different quantile features — the signal the
+        bucketed means saturate on at 1%-per-hour noise."""
+        import copy as _copy
+        lp = _arb_lp()
+        lp2 = _copy.copy(lp)
+        # double the spread around the mean: global mean preserved,
+        # per-bucket means shift far less than the quantile tails
+        lp2.c = lp.c.mean() + 2.0 * (lp.c - lp.c.mean())
+        nb = 4 * warmstart.FEATURE_BUCKETS
+        nq = len(warmstart.PRICE_QUANTILES)
+        f1 = warmstart.feature_vec(lp)
+        f2 = warmstart.feature_vec(lp2)
+        assert not np.allclose(f1[nb:nb + nq], f2[nb:nb + nq])
+
+    def test_soe_boundary_state_responds(self):
+        import copy as _copy
+        lp = _arb_lp()
+        lp2 = _copy.copy(lp)
+        q2 = lp.q.copy()
+        q2[lp.row_groups["soe"][0][0]] = 250.0    # halve the entry SOE
+        lp2.q = q2
+        f1 = warmstart.feature_vec(lp)
+        f2 = warmstart.feature_vec(lp2)
+        assert not np.allclose(f1[-warmstart.N_SOE_FEATURES:],
+                               f2[-warmstart.N_SOE_FEATURES:])
+
+    @pytest.mark.parametrize("pos", ["oldest", "newest"])
+    def test_refit_compatible_with_old_dim_entries(self, pos):
+        """Entries stored under an OLDER feature layout (a fleet import
+        from a pre-bump replica) are skipped at fit time — the model
+        still fits from the current-dim entries and serves.  The
+        'newest' case pins the reference-dim anchoring: an old-dim
+        entry arriving LAST must not flip the skip around and replace
+        a healthy model with an old-dim one."""
+        lp = _arb_lp()
+        opts = PDHGOptions(pallas_chunk=False)
+        solver = CompiledLPSolver(lp, opts)
+        mem = _trained_memory(solver, lp)
+        key = (next(iter(mem._entries)) if pos == "oldest"
+               else list(mem._entries)[-1])
+        legacy = mem._entries[key]
+        legacy.feature = legacy.feature[:4 * warmstart.FEATURE_BUCKETS]
+        plans = warmstart.plan_group(mem, "sk", [_far_instance(lp)],
+                                     opts, ["w0"])
+        assert plans[0].kind == "predicted"       # fit survived the mix
+        assert mem.predictor._models["sk"].feat_dim \
+            == warmstart.FEATURE_DIM
+
+    def test_old_dim_models_dropped_on_import(self):
+        """import_models drops models fitted under an older feature
+        dimension instead of installing a silent mis-predictor."""
+        lp = _arb_lp()
+        pred = seedpredict.SeedPredictor()
+        d_old = 4 * warmstart.FEATURE_BUCKETS          # pre-bump layout
+        old = [("sk-old", {"W": np.zeros((d_old + 1, lp.n + lp.m)),
+                           "n": lp.n, "m": lp.m, "trained_on": 8})]
+        new = [("sk-new", {"W": np.zeros(
+            (warmstart.FEATURE_DIM + 1, lp.n + lp.m)),
+            "n": lp.n, "m": lp.m, "trained_on": 8})]
+        assert pred.import_models(old) == 0
+        assert pred.import_models(new) == 1
+        assert not pred.has_model("sk-old")
+
+    def test_old_dim_pool_entry_never_wins_feature_fallback(self):
+        """A mixed pool (old-dim import + current entries) must serve
+        the nearest CURRENT-dim entry, not crash on the mismatch."""
+        lp = _arb_lp()
+        opts = PDHGOptions(pallas_chunk=False)
+        solver = CompiledLPSolver(lp, opts)
+        mem = _trained_memory(solver, lp, n_entries=2)  # no model (< min)
+        key = next(iter(mem._entries))
+        mem._entries[key].feature = \
+            mem._entries[key].feature[:4 * warmstart.FEATURE_BUCKETS]
+        plans = warmstart.plan_group(mem, "sk", [_far_instance(lp)],
+                                     opts, ["w0"])
+        assert plans[0].kind == "near"
+        assert plans[0].entry.feature.shape == (warmstart.FEATURE_DIM,)
