@@ -2,7 +2,9 @@
 reference run_DERVET.py:73-92).  ``dervet-tpu serve SPOOL_DIR`` starts
 the persistent scenario service instead (service.server.serve_main);
 ``dervet-tpu design CASE --bounds ...`` runs a one-shot BOOST sizing
-frontier (design.cli.design_main)."""
+frontier (design.cli.design_main); ``dervet-tpu portfolio REQ.json``
+runs a one-shot coupled-portfolio co-optimization
+(portfolio.cli.portfolio_main)."""
 from __future__ import annotations
 
 import argparse
@@ -22,6 +24,12 @@ def main(argv=None):
         # (0 ok, 75 preempted)
         from .design.cli import design_main
         raise SystemExit(design_main(argv[1:]))
+    if argv and argv[0] == "portfolio":
+        # one-shot coupled-portfolio co-optimization: dual-decomposed
+        # fleet solve against shared coupling constraints (exit 0 ok,
+        # 75 preempted, 2 infeasible)
+        from .portfolio.cli import portfolio_main
+        raise SystemExit(portfolio_main(argv[1:]))
 
     from .api import DERVET
 

@@ -502,6 +502,134 @@ def validate_certification(section: Dict) -> Dict:
 
 
 # ---------------------------------------------------------------------------
+# Portfolio-level certificate (coupled-site dual decomposition)
+# ---------------------------------------------------------------------------
+
+PORTFOLIO_NOT_CERTIFIED = "not_certified"
+
+
+def certify_portfolio(coupling_rows, primal_obj: float, dual_bound: float,
+                      policy: Optional[CertPolicy] = None, *,
+                      inner_exact: bool = False,
+                      per_site: Optional[Dict[str, Any]] = None
+                      ) -> Dict[str, Any]:
+    """Portfolio-level certificate for a dual-decomposed coupled solve
+    (``dervet_tpu/portfolio``), computed in FLOAT64 against the
+    UNSCALED aggregate data — independent of the dual loop's own
+    bookkeeping, the same trust posture as :func:`certify_solution`.
+
+    ``coupling_rows`` is a list of dicts, one per coupling constraint
+    family, each with ``kind`` (name), ``lhs`` (the aggregate activity
+    per row, LE-normalized so feasible means ``lhs <= rhs``), and
+    ``rhs``.  Violations are graded relative to each row's own activity
+    scale ``1 + |rhs| + |lhs|`` under the policy's ``eps_rel`` /
+    ``loose_factor`` bands.  The Lagrangian duality gap
+    ``primal - dual_bound`` is graded relative to
+    ``1 + |primal| + |dual|`` under ``eps_dual``.  ``inner_exact``
+    records whether the dual bound came from EXACT inner solves (cpu
+    backend) — with f32 first-order inner solves the bound carries the
+    inner tolerance and the gap is honest-but-approximate, which the
+    certificate says rather than hides.  ``per_site`` carries the
+    aggregated per-site PR-4 certificate counts for the final iterates.
+
+    Returns the ``portfolio`` certification section (run_health /
+    solve_ledger / ``service.metrics()['portfolio']`` surface)."""
+    policy = policy or policy_from_env()
+    rows_out: Dict[str, Any] = {}
+    if not policy.enabled:
+        return {"enabled": False, "verdict": PORTFOLIO_NOT_CERTIFIED,
+                "reason": "certification disabled (policy)",
+                "coupling_rows": rows_out, "gap_rel": None,
+                "primal_objective": float(primal_obj),
+                "dual_bound": float(dual_bound),
+                "inner_exact": bool(inner_exact),
+                "per_site": per_site or {}}
+    eps, loose = policy.eps_rel, policy.eps_rel * policy.loose_factor
+    reasons: List[str] = []
+    loose_hits: List[str] = []
+    for row in coupling_rows:
+        kind = str(row["kind"])
+        lhs = np.asarray(row["lhs"], np.float64)
+        rhs = np.asarray(row["rhs"], np.float64)
+        scale = 1.0 + np.abs(rhs) + np.abs(lhs)
+        viol = np.maximum(lhs - rhs, 0.0)
+        rel = viol / scale
+        j = int(np.argmax(rel)) if rel.size else -1
+        rel_max = float(rel[j]) if j >= 0 else 0.0
+        binding = int(np.sum(np.abs(lhs - rhs) <= eps * scale)) \
+            if rel.size else 0
+        rows_out[kind] = {
+            "rows": int(lhs.size),
+            "abs_max_kw": float(viol[j]) if j >= 0 else 0.0,
+            "rel_max": rel_max,
+            "worst_row": j,
+            "binding": binding,
+            "ok": rel_max <= loose,
+        }
+        if rel_max > loose:
+            reasons.append(f"coupling row {kind}[{j}] violated "
+                           f"{rel_max:.2e} rel (> {loose:.0e})")
+        elif rel_max > eps:
+            loose_hits.append(f"coupling {kind} {rel_max:.2e}")
+    gap = max(float(primal_obj) - float(dual_bound), 0.0)
+    gap_rel = gap / (1.0 + abs(float(primal_obj))
+                     + abs(float(dual_bound)))
+    dl = policy.eps_dual * policy.loose_factor
+    if gap_rel > dl:
+        reasons.append(f"duality gap {gap_rel:.2e} rel (> {dl:.0e})")
+    elif gap_rel > policy.eps_dual:
+        loose_hits.append(f"gap {gap_rel:.2e}")
+    ps = dict(per_site or {})
+    if ps and not ps.get("all_certified", True):
+        reasons.append(
+            f"{ps.get('windows_total', 0) - ps.get('windows_certified', 0)}"
+            " site window(s) without an accepted float64 certificate")
+    if reasons:
+        verdict, reason = VERDICT_REJECTED, "; ".join(reasons)
+    elif loose_hits:
+        verdict, reason = VERDICT_LOOSE, "; ".join(loose_hits)
+    else:
+        verdict, reason = VERDICT_CERTIFIED, ""
+    return {"enabled": True, "verdict": verdict, "reason": reason,
+            "coupling_rows": rows_out,
+            "gap_rel": float(gap_rel), "gap_abs": float(gap),
+            "primal_objective": float(primal_obj),
+            "dual_bound": float(dual_bound),
+            "inner_exact": bool(inner_exact),
+            "per_site": ps,
+            "policy": policy.as_dict()}
+
+
+def validate_portfolio_certification(section: Dict) -> Dict:
+    """Schema-check a ``portfolio`` certification section (raises
+    ``ValueError`` naming the missing/invalid field; returns the section
+    unchanged).  Used by ``scripts/portfolio_smoke.py`` and CI."""
+    if not isinstance(section, dict):
+        raise ValueError(
+            f"portfolio section must be a dict, got {type(section)}")
+    for k in ("enabled", "verdict", "coupling_rows", "gap_rel",
+              "primal_objective", "dual_bound", "inner_exact",
+              "per_site"):
+        if k not in section:
+            raise ValueError(f"portfolio certification missing {k!r}")
+    if section["verdict"] not in (VERDICT_CERTIFIED, VERDICT_LOOSE,
+                                  VERDICT_REJECTED,
+                                  PORTFOLIO_NOT_CERTIFIED):
+        raise ValueError(
+            f"portfolio verdict invalid: {section['verdict']!r}")
+    for kind, row in (section["coupling_rows"] or {}).items():
+        for k in ("rows", "rel_max", "abs_max_kw", "binding", "ok"):
+            if k not in row:
+                raise ValueError(
+                    f"portfolio coupling row {kind!r} missing {k!r}")
+    if section["enabled"] and section["gap_rel"] is not None \
+            and section["gap_rel"] < 0:
+        raise ValueError(f"portfolio gap_rel negative: "
+                         f"{section['gap_rel']}")
+    return section
+
+
+# ---------------------------------------------------------------------------
 # Scenario-level physical-invariant audit
 # ---------------------------------------------------------------------------
 

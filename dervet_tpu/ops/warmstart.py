@@ -33,6 +33,21 @@ UNSCALED ``(x, y)`` iterates per LP structure key.  Two lookup grades:
   anchors reset — and the solve runs its normal convergence criteria
   from there.
 
+A third, caller-keyed grade serves the portfolio dual loop
+(``dervet_tpu/portfolio``): a **dual_iterate** hint.  A dual-price
+update perturbs EVERY price entry of a member's ``c`` at once, so the
+float16 quantized digest moves in every price feature and the near
+grade degrades to the feature-nearest fallback (or cold) exactly on the
+workload it was built for.  Callers that KNOW two solves are successive
+iterates of one outer loop attach ``lp.seed_hint = (tag, site,
+window)``; the memory keeps a side table of the latest converged
+iterate per hint key (:meth:`SolutionMemory.store_hint` /
+:meth:`SolutionMemory.lookup_hint`), and :func:`plan_group` ranks a
+hint hit ABOVE near/predicted (the member's own last iterate beats any
+neighbor) but below exact substitution (byte-identical data still ships
+verbatim with zero device work).  A hint seed is iterate seeding only —
+the data differs by construction, so it can never substitute.
+
 Safety argument: a warm-started window still runs full convergence
 criteria and full PR-4 float64 certification, so a stale, evicted, or
 poisoned seed can only cost iterations, never correctness — the
@@ -262,8 +277,13 @@ class SeedEntry:
 @dataclasses.dataclass
 class MemberPlan:
     """One group member's warm-start decision."""
-    kind: str              # "cold" | "predicted" | "near" | "exact"
+    # "cold" | "predicted" | "near" | "dual_iterate" | "exact"
+    kind: str
     entry: Optional[SeedEntry] = None
+    # the member's ``lp.seed_hint`` (portfolio dual loop), kept on the
+    # plan even for cold members so the post-solve store can index the
+    # converged iterate for the NEXT dual iteration
+    hint: Optional[tuple] = None
     substituted: bool = False        # exact hit that passed the f64 check
     stale_fault: bool = False        # seed corrupted by fault injection
     # substitution verdict + residuals (the INACCURATE band re-ships the
@@ -296,9 +316,13 @@ class SolutionMemory:
         self._by_quant: Dict[tuple, tuple] = {}
         self._cold_iters: Dict[object, deque] = {}
         self.stats = {"stores": 0, "evictions": 0, "hits_exact": 0,
-                      "hits_near": 0, "hits_predicted": 0, "misses": 0,
+                      "hits_near": 0, "hits_predicted": 0,
+                      "hits_dual": 0, "misses": 0,
                       "substituted": 0, "stale_seed_faults": 0,
                       "invalidated": 0, "imported": 0}
+        # dual-iterate side table: hint key -> latest converged iterate
+        # (the portfolio dual loop's reseeding store; see module doc)
+        self._hints: "OrderedDict[tuple, SeedEntry]" = OrderedDict()
         # keys imported from another replica's export (fleet failover):
         # these serve the EXACT path only — see import_entries
         self._imported_keys: set = set()
@@ -428,6 +452,37 @@ class SolutionMemory:
         self.predictor.invalidate(skey)
         return len(doomed)
 
+    # -- dual-iterate hint table (portfolio outer loop) -----------------
+    def store_hint(self, hint, x, y, obj: float) -> None:
+        """Index one converged iterate under a caller-chosen hint key —
+        the portfolio dual loop stores iteration k's solution here so
+        iteration k+1 (same site/window, price-shifted ``c``) reseeds
+        from it even though every quantized price feature moved.
+        Bounded by the same LRU cap as the primary store; each key
+        holds only its LATEST iterate (older dual iterates are strictly
+        worse seeds)."""
+        entry = SeedEntry(
+            x=np.array(x, copy=True), y=np.array(y, copy=True),
+            obj=float(obj), feature=np.zeros(0), tag=(), exact=b"",
+            quant=b"")
+        with self._lock:
+            key = tuple(hint)
+            self._hints.pop(key, None)
+            self._hints[key] = entry
+            while len(self._hints) > self.max_entries:
+                self._hints.popitem(last=False)
+
+    def lookup_hint(self, hint) -> Optional[SeedEntry]:
+        """The latest iterate stored under ``hint``, or None.  Bumps the
+        ``hits_dual`` counter on a hit (the caller reclassifies the
+        probe's own counter — see :func:`plan_group`)."""
+        with self._lock:
+            e = self._hints.get(tuple(hint))
+            if e is not None:
+                self._hints.move_to_end(tuple(hint))
+                self.stats["hits_dual"] += 1
+            return e
+
     def entries_for_structure(self, skey) -> List[SeedEntry]:
         """Live entries for one structure, oldest-first — the learned
         predictor's training set (a locked snapshot of references; the
@@ -525,6 +580,7 @@ class SolutionMemory:
     def snapshot(self) -> Dict:
         with self._lock:
             snap = {"entries": len(self._entries),
+                    "hint_entries": len(self._hints),
                     "structures": len(self._by_struct),
                     "imported_live": len(self._imported_keys),
                     "max_entries": self.max_entries,
@@ -540,11 +596,14 @@ def plan_group(memory: SolutionMemory, skey, lps, opts, labels
     """Per-member warm-start plan for one structure group.
 
     Grade ladder per member: **exact** (byte-identical data + tag, may
-    substitute), **near** (quantized-digest hit — a stored iterate whose
-    data agrees to ~3 significant digits), **predicted** (the learned
-    seed model's interpolation — outranks the nearest-by-feature
-    fallback, whose entry may be arbitrarily far, but never a genuine
-    near hit), feature-nearest (reported as ``near``), cold.
+    substitute), **dual_iterate** (the member carries an
+    ``lp.seed_hint`` and the hint table holds its previous outer-loop
+    iterate — the member's OWN last trajectory outranks any neighbor's),
+    **near** (quantized-digest hit — a stored iterate whose data agrees
+    to ~3 significant digits), **predicted** (the learned seed model's
+    interpolation — outranks the nearest-by-feature fallback, whose
+    entry may be arbitrarily far, but never a genuine near hit),
+    feature-nearest (reported as ``near``), cold.
 
     Exact hits are promoted to substitution only after the stored
     solution passes :func:`check_converged_host` under the CURRENT
@@ -565,6 +624,19 @@ def plan_group(memory: SolutionMemory, skey, lps, opts, labels
         predictor.maybe_fit(skey, memory.entries_for_structure(skey))
     for lp, label in zip(lps, labels):
         entry, kind, exact, quant = memory.probe(skey, lp, tag)
+        hint = getattr(lp, "seed_hint", None)
+        if hint is not None and kind != "exact":
+            # dual-iterate grade: the member's own previous outer-loop
+            # iterate beats any quantized-digest neighbor — a dual
+            # update shifts every price feature, so the near grade
+            # degrades exactly on this workload (the PR-13 fix)
+            h = memory.lookup_hint(hint)
+            if h is not None:
+                # RECLASSIFY the probe's counter, same discipline as
+                # the predicted grade below
+                memory.bump("hits_near" if kind in ("near", "feature")
+                            else "misses", -1)
+                entry, kind = h, "dual_iterate"
         if use_pred and kind in (None, "feature"):
             pred = predictor.predict(skey, feature_vec(lp))
             if pred is not None:
@@ -584,7 +656,7 @@ def plan_group(memory: SolutionMemory, skey, lps, opts, labels
         if kind == "feature":
             kind = "near"
         if entry is None:
-            plans.append(MemberPlan("cold", exact_digest=exact,
+            plans.append(MemberPlan("cold", hint=hint, exact_digest=exact,
                                     quant_digest=quant))
             continue
         if fplan is not None and fplan.stale_seed_due(label):
@@ -597,11 +669,11 @@ def plan_group(memory: SolutionMemory, skey, lps, opts, labels
                               exact=b"", quant=b"")
             memory.bump("stale_seed_faults")
             plans.append(MemberPlan(
-                kind if kind == "predicted" else "near", stale,
-                stale_fault=True, exact_digest=exact,
+                kind if kind in ("predicted", "dual_iterate") else "near",
+                stale, hint=hint, stale_fault=True, exact_digest=exact,
                 quant_digest=quant))
             continue
-        mp = MemberPlan(kind, entry, exact_digest=exact,
+        mp = MemberPlan(kind, entry, hint=hint, exact_digest=exact,
                         quant_digest=quant)
         if kind == "exact":
             terms = host_kkt(lp, entry.x, entry.y)

@@ -1584,6 +1584,14 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
                              dev_obj[row],
                              exact=plan_w[i].exact_digest,
                              quant=plan_w[i].quant_digest)
+                if plan_w[i].hint is not None:
+                    # dual-iterate hint table (portfolio outer loop):
+                    # index this converged iterate under the member's
+                    # (tag, site, window) key so the NEXT dual
+                    # iteration — price-shifted data, same member —
+                    # reseeds from it instead of falling cold
+                    memory.store_hint(plan_w[i].hint, dev_x[row],
+                                      dev_y[row], dev_obj[row])
             if plan_w[i].kind == "cold" and \
                     dev_st[row] in (STATUS_CONVERGED, STATUS_INACCURATE):
                 # accepted exits only: an iteration-limit exit would
@@ -1592,6 +1600,15 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
                 cold_iters.append(dev_it[row])
         if cold_iters:
             memory.note_cold_iters(key, cold_iters)
+    if plan_w is not None:
+        # outside the device-members gate on purpose: a fully
+        # substituted group makes NO device call (dev_y is None), but
+        # its hint entries must still refresh to the shipped solutions
+        # — the next dual iteration's price move has to find them
+        for i in range(n_mem):
+            if plan_w[i].hint is not None and plan_w[i].substituted:
+                e = plan_w[i].entry
+                memory.store_hint(plan_w[i].hint, e.x, e.y, e.obj)
     # rolling per-structure iteration baseline: the elastic scheduler's
     # placement cost (windows x horizon x baseline) feeds from here
     if cache is not None and key is not None and n_mem and \
@@ -1657,6 +1674,9 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
                     # learned-predictor grade (ops/seedpredict.py)
                     "predicted": sum(1 for mp in plan_w
                                      if mp.kind == "predicted"),
+                    # portfolio dual-loop hint grade (ops/warmstart.py)
+                    "dual_iterate": sum(1 for mp in plan_w
+                                        if mp.kind == "dual_iterate"),
                     "substituted": int(sum(substituted)),
                     "stale_seed_faults": sum(1 for mp in plan_w
                                              if mp.stale_fault),
@@ -1664,8 +1684,8 @@ def solve_group(lp0: LP, lps: List[LP], backend: str, solver_opts,
             else:
                 seeded_i = list(range(n_mem))
                 warm = {"source": "failed_iterate", "exact": 0,
-                        "near": n_mem, "predicted": 0, "substituted": 0,
-                        "stale_seed_faults": 0}
+                        "near": n_mem, "predicted": 0, "dual_iterate": 0,
+                        "substituted": 0, "stale_seed_faults": 0}
             cold_i = [i for i in range(n_mem) if i not in set(seeded_i)]
             warm["seeded"] = len(seeded_i)
             warm["cold"] = len(cold_i)
@@ -2480,8 +2500,8 @@ def summarize_solve_ledger(entries, dispatch_solve_s: float,
     warm_cold_it: list = []
     warm_pred_it: list = []
     warm_tot = {"seeded": 0, "cold": 0, "substituted": 0, "exact": 0,
-                "near": 0, "predicted": 0, "stale_seed_faults": 0,
-                "iters_saved": 0}
+                "near": 0, "predicted": 0, "dual_iterate": 0,
+                "stale_seed_faults": 0, "iters_saved": 0}
     warm_seen = False
     # solver-core aggregation (ROADMAP item 1): which step variant each
     # group ran, total adaptive restarts (== Halpern anchor resets under

@@ -55,25 +55,33 @@ class ScenarioClient:
                                             self.jitter_frac)
         return max(0.0, wait)
 
-    def submit(self, cases, *, request_id=None, priority: int = 0,
-               deadline_s: Optional[float] = None) -> Future:
-        """Admit with bounded, jittered retry-after backoff on
-        queue-full."""
+    def _submit_with_retry(self, label: str, attempt_fn) -> Future:
+        """The one retry discipline every request type shares: bounded
+        attempts, capped ±jittered backoff on the server's retry-after
+        hint (see class docstring)."""
         attempt = 0
         while True:
             try:
-                return self.service.submit(cases, request_id=request_id,
-                                           priority=priority,
-                                           deadline_s=deadline_s)
+                return attempt_fn()
             except QueueFullError as e:
                 attempt += 1
                 if attempt > self.max_retries:
                     raise
                 wait = self._backoff_s(e.retry_after_s)
                 TellUser.info(
-                    f"client: queue full, retry {attempt}/"
+                    f"client: queue full, {label}retry {attempt}/"
                     f"{self.max_retries} in {wait:.2f}s")
                 time.sleep(wait)
+
+    def submit(self, cases, *, request_id=None, priority: int = 0,
+               deadline_s: Optional[float] = None) -> Future:
+        """Admit with bounded, jittered retry-after backoff on
+        queue-full."""
+        return self._submit_with_retry(
+            "", lambda: self.service.submit(cases,
+                                            request_id=request_id,
+                                            priority=priority,
+                                            deadline_s=deadline_s))
 
     def solve(self, cases, *, timeout: Optional[float] = None,
               **kwargs):
@@ -90,21 +98,10 @@ class ScenarioClient:
                       **spec_kwargs) -> Future:
         """Admit a DESIGN request (BOOST sizing frontier) with the same
         bounded, jittered retry-after backoff as :meth:`submit`."""
-        attempt = 0
-        while True:
-            try:
-                return self.service.submit_design(
-                    case, spec, request_id=request_id, priority=priority,
-                    deadline_s=deadline_s, **spec_kwargs)
-            except QueueFullError as e:
-                attempt += 1
-                if attempt > self.max_retries:
-                    raise
-                wait = self._backoff_s(e.retry_after_s)
-                TellUser.info(
-                    f"client: queue full, design retry {attempt}/"
-                    f"{self.max_retries} in {wait:.2f}s")
-                time.sleep(wait)
+        return self._submit_with_retry(
+            "design ", lambda: self.service.submit_design(
+                case, spec, request_id=request_id, priority=priority,
+                deadline_s=deadline_s, **spec_kwargs))
 
     def design(self, case, spec=None, *,
                timeout: Optional[float] = None, **kwargs):
@@ -113,4 +110,24 @@ class ScenarioClient:
         ``frontier.fidelity`` — a ``"degraded"`` frontier was load-shed
         and is ranked by the ordinal screen only (no certificates)."""
         return self.submit_design(case, spec, **kwargs).result(
+            timeout=timeout)
+
+    def submit_portfolio(self, spec, *, request_id=None,
+                         priority: int = 0,
+                         deadline_s: Optional[float] = None) -> Future:
+        """Admit a PORTFOLIO request (coupled-fleet co-optimization)
+        with the same bounded, jittered retry-after backoff as
+        :meth:`submit`."""
+        return self._submit_with_retry(
+            "portfolio ", lambda: self.service.submit_portfolio(
+                spec, request_id=request_id, priority=priority,
+                deadline_s=deadline_s))
+
+    def portfolio(self, spec, *, timeout: Optional[float] = None,
+                  **kwargs):
+        """Submit a portfolio request and block for its
+        :class:`~dervet_tpu.portfolio.solve.PortfolioResult`.  Check
+        ``result.fidelity`` — a ``"degraded"`` answer was load-shed to
+        the screening tier and carries no certificates."""
+        return self.submit_portfolio(spec, **kwargs).result(
             timeout=timeout)

@@ -45,15 +45,17 @@ class QueuedRequest:
     and the future the result is delivered through.
 
     ``kind`` distinguishes the request types the service serves:
-    ``"scenario"`` (solve these cases) and ``"design"`` (BOOST sizing —
+    ``"scenario"`` (solve these cases), ``"design"`` (BOOST sizing —
     ``design_case``/``design_spec`` carry the base case + spec, the
     screening phase fills ``cases`` with the finalist candidate cases,
     and ``design_state`` carries the screening results to frontier
-    assembly at delivery)."""
+    assembly at delivery), and ``"portfolio"`` (coupled-fleet
+    co-optimization — ``portfolio_spec`` carries the member cases +
+    coupling constraints; the dual loop runs in its own round)."""
 
     __slots__ = ("request_id", "cases", "priority", "deadline", "future",
                  "seq", "t_submit", "fingerprint", "kind", "design_case",
-                 "design_spec", "design_state")
+                 "design_spec", "design_state", "portfolio_spec")
 
     def __init__(self, request_id: str, cases: Dict, priority: int = 0,
                  deadline_s: Optional[float] = None, seq: int = 0,
@@ -73,6 +75,7 @@ class QueuedRequest:
         self.design_case = None
         self.design_spec = None
         self.design_state = None
+        self.portfolio_spec = None
 
     def expired(self) -> bool:
         return self.deadline is not None and time.monotonic() > self.deadline

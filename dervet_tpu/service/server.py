@@ -145,6 +145,15 @@ class ScenarioService:
                         "screen_s": 0.0, "finalists": 0,
                         "degraded_answers": 0, "screen_dispatches": 0,
                         "screen_compile_events": 0}
+        # portfolio-request counters (dervet_tpu/portfolio): coupled
+        # fleets solved through the dual-decomposed outer loop
+        self._portfolio = {"requests": 0, "outer_rounds": 0,
+                           "windows": 0, "dual_iterate_seeds": 0,
+                           "degraded_answers": 0, "infeasible": 0,
+                           "failed": 0, "portfolio_s": 0.0}
+        # the last portfolio solve's observability section (gap, rounds,
+        # certificate) — the smoke/bench gates' surface
+        self.last_portfolio: Optional[Dict] = None
         # the last design screening's per-round stats (the zero-compile
         # warm observable the design smoke gates on)
         self.last_screen_stats: Optional[Dict] = None
@@ -236,9 +245,31 @@ class ScenarioService:
                            kind="design", design_case=case,
                            design_spec=spec)
 
+    def submit_portfolio(self, spec, *, request_id=None,
+                         priority: int = 0,
+                         deadline_s: Optional[float] = None) -> Future:
+        """Admit one PORTFOLIO request (coupled-fleet co-optimization):
+        solve ``spec``'s member sites as one LP under the shared
+        coupling constraints via the dual-decomposed outer loop
+        (``dervet_tpu.portfolio``), deliver a
+        :class:`~dervet_tpu.portfolio.solve.PortfolioResult` through
+        the returned future.  Admission semantics (priority, deadline,
+        backpressure, poison blocklist, draining) are identical to
+        :meth:`submit`.  The dual loop dispatches through the
+        service's persistent solver cache, so repeat portfolios reuse
+        compiled programs AND the warm-start memory."""
+        from ..portfolio.service import portfolio_fingerprint
+        if self._draining.is_set():
+            raise ServiceClosedError(
+                "service is draining — no new admissions")
+        spec.validate()       # spec errors raise HERE, at admission
+        fingerprint = portfolio_fingerprint(spec)
+        return self._admit(request_id, fingerprint, priority, deadline_s,
+                           kind="portfolio", portfolio_spec=spec)
+
     def _admit(self, request_id, fingerprint, priority, deadline_s, *,
                cases=None, kind: str = "scenario", design_case=None,
-               design_spec=None) -> Future:
+               design_spec=None, portfolio_spec=None) -> Future:
         """Shared admission tail: backend breaker, poison blocklist,
         id allocation/validation, queue put with typed rejection."""
         if self.breakers.is_open("backend"):
@@ -281,6 +312,7 @@ class ScenarioService:
         req.fingerprint = fingerprint
         req.design_case = design_case
         req.design_spec = design_spec
+        req.portfolio_spec = portfolio_spec
         req.future.add_done_callback(
             lambda _f, rid=str(request_id): self._release_id(rid))
         try:
@@ -331,6 +363,18 @@ class ScenarioService:
             payload = json.load(f)
         case, spec = parse_design_request(payload, base_path=base_path)
         return self.submit_design(case, spec, **kwargs)
+
+    def submit_portfolio_file(self, path, base_path=None,
+                              **kwargs) -> Future:
+        """Admit a spool ``portfolio.json`` request file (see
+        ``portfolio.service.parse_portfolio_request`` for the shape);
+        parse errors raise here, at admission."""
+        import json
+        from ..portfolio.service import parse_portfolio_request
+        with open(path) as f:
+            payload = json.load(f)
+        spec = parse_portfolio_request(payload, base_path=base_path)
+        return self.submit_portfolio(spec, **kwargs)
 
     # -- batching loop --------------------------------------------------
     def start(self) -> "ScenarioService":
@@ -415,7 +459,45 @@ class ScenarioService:
                        if r.kind == "design"]
         certified = [r for r in certified if r.kind != "design"]
         degraded = [r for r in degraded if r.kind != "design"]
+        # portfolio requests run their own dual-loop round against the
+        # service's persistent caches; a load-SHED portfolio runs the
+        # degraded tier (screening inner solves, certification off,
+        # answer marked — never certificate-stamped)
+        portfolio_shed_ids = {r.request_id for r in degraded
+                              if r.kind == "portfolio"}
+        portfolio_reqs = [r for r in certified + degraded
+                          if r.kind == "portfolio"]
+        certified = [r for r in certified if r.kind != "portfolio"]
+        degraded = [r for r in degraded if r.kind != "portfolio"]
         served = 0
+        if portfolio_reqs:
+            from ..portfolio.service import PortfolioRound
+            pr = PortfolioRound(portfolio_reqs, backend=self.backend,
+                                solver_opts=self.solver_opts,
+                                solver_cache=self.solver_cache,
+                                degraded_cache=self.degraded_cache,
+                                degraded_ids=portfolio_shed_ids,
+                                supervisor=self.supervisor,
+                                board=self.breakers)
+            try:
+                pr.run()
+            except BaseException as e:
+                # the portfolio round answers its own requests (incl.
+                # preemption); every OTHER request this cycle already
+                # popped from the queue must be answered here or its
+                # client hangs forever
+                for req in design_reqs + degraded + certified:
+                    if not req.future.done():
+                        req.future.set_exception(ServiceClosedError(
+                            f"request {req.request_id!r} not "
+                            "dispatched: the portfolio round failed "
+                            f"({e}) — resubmit"))
+                        with self._metrics_lock:
+                            self._requests["failed"] += 1
+                self._absorb_portfolio_stats(pr)
+                raise
+            self._absorb_portfolio_stats(pr)
+            served += len(pr.answered)
         if design_reqs:
             from ..design.service import DesignRound
             dr = DesignRound(design_reqs, backend=self.backend,
@@ -523,6 +605,29 @@ class ScenarioService:
                     self._requests["failed"] += 1
         if dr.last_screen is not None:
             self.last_screen_stats = dr.last_screen
+
+    def _absorb_portfolio_stats(self, pr) -> None:
+        """Portfolio-round bookkeeping + request accounting (the round
+        answers every future itself)."""
+        st = pr.stats
+        with self._metrics_lock:
+            for k in ("requests", "outer_rounds", "windows",
+                      "dual_iterate_seeds", "infeasible", "failed"):
+                self._portfolio[k] += int(st.get(k, 0))
+            self._portfolio["degraded_answers"] += int(
+                st.get("degraded", 0))
+            self._portfolio["portfolio_s"] += float(
+                st.get("portfolio_s", 0.0))
+            for req in pr.answered:
+                fut = req.future
+                if fut.done() and fut.exception() is None:
+                    self._requests["completed"] += 1
+                    self._latencies.append(
+                        time.monotonic() - req.t_submit)
+                elif fut.done():
+                    self._requests["failed"] += 1
+        if pr.last_portfolio is not None:
+            self.last_portfolio = pr.last_portfolio
 
     def _absorb_round_stats(self, rnd: BatchRound) -> None:
         """Round-level bookkeeping, fired by the batcher BEFORE any
@@ -649,6 +754,7 @@ class ScenarioService:
             rounds = dict(self._rounds)
             requests = dict(self._requests)
             design = dict(self._design)
+            portfolio = dict(self._portfolio)
             elastic = dict(self._elastic)
         design["screen_s"] = round(design["screen_s"], 3)
         design["screen_candidates_per_s"] = round(
@@ -669,6 +775,13 @@ class ScenarioService:
             # design-service load, separate from scenario rounds so the
             # two request types are distinguishable under pressure
             "design": design,
+            # portfolio co-optimization load (dervet_tpu/portfolio):
+            # request/round counters plus the last dual loop's full
+            # observability section (gap, per-round seeding, cert)
+            "portfolio": {**{k: (round(v, 3)
+                                 if k == "portfolio_s" else v)
+                             for k, v in portfolio.items()},
+                          "last": self.last_portfolio},
             "batch_occupancy": {
                 "mean_windows_per_device_batch":
                     round(groups[0] / groups[1], 2) if groups[1] else 0.0,
@@ -986,18 +1099,26 @@ def serve_main(argv=None) -> int:
                         faultinject.maybe_replica_crash(admissions)
                         continue
                     # a JSON file with a top-level "design" object is a
-                    # BOOST design request (base case + bounds spec),
-                    # not a model-parameters file
-                    is_design = False
+                    # BOOST design request; one with a top-level
+                    # "portfolio" object is a coupled-fleet request —
+                    # anything else is a model-parameters file
+                    is_design = is_portfolio = False
                     if path.suffix.lower() == ".json":
                         from ..design.service import is_design_payload
+                        from ..portfolio.service import \
+                            is_portfolio_payload
                         try:
                             with open(path) as fh:
-                                is_design = is_design_payload(
-                                    json.load(fh))
+                                payload = json.load(fh)
+                            is_design = is_design_payload(payload)
+                            is_portfolio = is_portfolio_payload(payload)
                         except Exception:
-                            is_design = False   # params path reports it
-                    if is_design:
+                            is_design = is_portfolio = False
+                    if is_portfolio:
+                        fut = service.submit_portfolio_file(
+                            path, base_path=args.base_path,
+                            request_id=rid)
+                    elif is_design:
                         fut = service.submit_design_file(
                             path, base_path=args.base_path,
                             request_id=rid)

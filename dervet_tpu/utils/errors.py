@@ -169,6 +169,27 @@ class PoisonRequestError(ServiceError):
         self.diagnosis = diagnosis
 
 
+class PortfolioInfeasibleError(ServiceError):
+    """The portfolio's coupling rows cannot be satisfied by ANY member
+    dispatch (e.g. an aggregate import cap below the fleet's must-serve
+    load): the dual loop terminates with this typed diagnosis instead of
+    burning its outer-iteration budget on a divergent price search.
+    ``violations`` lists the violated rows — each a dict with the
+    coupling ``kind``, the worst timestep index/stamp, the required vs
+    achievable aggregate kW, and the shortfall."""
+
+    kind = "portfolio_infeasible"
+
+    def __init__(self, msg: str, violations=None):
+        super().__init__(msg)
+        self.violations = list(violations or [])
+
+    def as_dict(self) -> Dict:
+        d = super().as_dict()
+        d["violations"] = self.violations
+        return d
+
+
 class BreakerOpenError(ServiceError):
     """Admission refused: the service's backend circuit breaker is open
     (backend re-initialization and the CPU failover both failed) — the

@@ -176,6 +176,7 @@ EVENT_STALE_SEED = "stale_seed"     # warm-start seed corrupted pre-solve
 EVENT_STRAGGLER = "straggler"       # one device's solves slowed (elastic)
 EVENT_REPLICA_CRASH = "replica_crash"   # serve loop hard-exits (SIGKILL-like)
 EVENT_REPLICA_HANG = "replica_hang"     # serve loop sleeps; heartbeats stop
+EVENT_DIVERGING_DUALS = "diverging_duals"  # portfolio dual update corrupted
 
 
 class InjectedCrashError(RuntimeError):
@@ -225,7 +226,9 @@ class FaultPlan:
                  straggler_seconds: float = 0.75,
                  replica_crash_after: Optional[int] = None,
                  replica_hang_after: Optional[int] = None,
-                 replica_hang_seconds: float = 3600.0):
+                 replica_hang_seconds: float = 3600.0,
+                 diverge_duals_round: Optional[int] = None,
+                 diverge_duals_scale: float = 25.0):
         self.nonconverge = _norm(nonconverge)
         self.rungs = _norm(rungs)
         self.poison_cases = _norm(poison_cases)
@@ -287,6 +290,14 @@ class FaultPlan:
         self.replica_hang_seconds = float(replica_hang_seconds)
         self._replica_crash_fired = False
         self._replica_hang_fired = False
+        # diverging_duals (portfolio dual loop): corrupt the combined
+        # dual-price vector ONCE, at outer round `diverge_duals_round` —
+        # the loop must detect the non-monotone gap, rescale its step,
+        # and still converge + certify
+        self.diverge_duals_round = (None if diverge_duals_round is None
+                                    else int(diverge_duals_round))
+        self.diverge_duals_scale = float(diverge_duals_scale)
+        self._diverge_fired = False
         self._preempt_fired = False
         self.fired: List[Tuple[str, str]] = []   # (rung/event, label/case)
 
@@ -400,6 +411,16 @@ class FaultPlan:
         self.fired.append((EVENT_REPLICA_HANG, str(admissions_done)))
         return self.replica_hang_seconds
 
+    def diverge_duals_due(self, round_idx: int) -> bool:
+        """Should THIS outer dual round's price update be corrupted?
+        One-shot, keyed on the round index."""
+        if self.diverge_duals_round is None or self._diverge_fired or \
+                int(round_idx) != self.diverge_duals_round:
+            return False
+        self._diverge_fired = True
+        self.fired.append((EVENT_DIVERGING_DUALS, str(round_idx)))
+        return True
+
     def preempt_due(self, batches_done: int) -> bool:
         if self.preempt_after is None or self._preempt_fired or \
                 batches_done < self.preempt_after:
@@ -432,7 +453,9 @@ _ENV_VARS = ("DERVET_TPU_FAULT_NONCONVERGE", "DERVET_TPU_FAULT_POISON_CASE",
              "DERVET_TPU_FAULT_STRAGGLER_S",
              "DERVET_TPU_FAULT_REPLICA_CRASH",
              "DERVET_TPU_FAULT_REPLICA_HANG",
-             "DERVET_TPU_FAULT_REPLICA_HANG_S")
+             "DERVET_TPU_FAULT_REPLICA_HANG_S",
+             "DERVET_TPU_FAULT_DIVERGE_DUALS",
+             "DERVET_TPU_FAULT_DIVERGE_DUALS_SCALE")
 _ENV_PLAN: Optional[FaultPlan] = None
 _ENV_SNAPSHOT: Optional[tuple] = None
 
@@ -455,8 +478,9 @@ def _plan_from_env() -> Optional[FaultPlan]:
     st_on = st not in ("", "0", "false", "off")
     rcr = os.environ.get("DERVET_TPU_FAULT_REPLICA_CRASH")
     rhg = os.environ.get("DERVET_TPU_FAULT_REPLICA_HANG")
+    dd = os.environ.get("DERVET_TPU_FAULT_DIVERGE_DUALS")
     if not (nc or pc or cf or hg or sl or pa or cr or ov_on or dl_on
-            or crash or ss or st_on or rcr or rhg):
+            or crash or ss or st_on or rcr or rhg or dd):
         return None
     ov_n = os.environ.get("DERVET_TPU_FAULT_OVERLOAD_N")
     rungs = os.environ.get("DERVET_TPU_FAULT_RUNGS", RUNG_SOLVE)
@@ -490,7 +514,10 @@ def _plan_from_env() -> Optional[FaultPlan]:
         replica_crash_after=int(rcr) if rcr else None,
         replica_hang_after=int(rhg) if rhg else None,
         replica_hang_seconds=float(
-            os.environ.get("DERVET_TPU_FAULT_REPLICA_HANG_S", 3600)))
+            os.environ.get("DERVET_TPU_FAULT_REPLICA_HANG_S", 3600)),
+        diverge_duals_round=int(dd) if dd else None,
+        diverge_duals_scale=float(
+            os.environ.get("DERVET_TPU_FAULT_DIVERGE_DUALS_SCALE", 25.0)))
 
 
 def get_plan() -> Optional[FaultPlan]:
@@ -660,6 +687,25 @@ def maybe_replica_hang(admissions_done: int) -> float:
     if secs > 0:
         time.sleep(secs)
     return secs
+
+
+def maybe_diverge_duals(round_idx: int, price: np.ndarray
+                        ) -> Optional[np.ndarray]:
+    """``diverging_duals`` injection point in the portfolio outer loop,
+    called on the combined dual-price vector right after a dual update:
+    when due, return a deterministically corrupted copy (scaled +
+    perturbed, clipped non-negative — a wildly wrong but sign-valid
+    price vector); None in the no-plan fast path.  The loop's
+    non-monotone-gap detector must catch the regression, rescale its
+    dual step, and still converge + certify — dual corruption costs
+    outer rounds, never correctness."""
+    plan = get_plan()
+    if plan is None or not plan.diverge_duals_due(round_idx):
+        return None
+    bad = corrupt_array(np.array(price, np.float64, copy=True),
+                        f"diverge_duals|{round_idx}",
+                        plan.diverge_duals_scale)
+    return np.maximum(bad, 0.0)
 
 
 def maybe_preempt(batches_done: int) -> bool:

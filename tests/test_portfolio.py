@@ -1,0 +1,468 @@
+"""Portfolio co-optimization: dual-decomposed coupled-site LPs.
+
+The portfolio contract under test:
+
+* decomposition CORRECTNESS — a 2-site toy portfolio with a binding
+  shared export cap matches a monolithic HiGHS solve of the full
+  coupled LP to 1e-6 objective agreement (exact cpu inner solves +
+  finite column-generation convergence);
+* coupling-row FEASIBILITY of the blended answer at termination,
+  certified in float64 against the unscaled aggregate;
+* BYTE-DETERMINISM of a repeated portfolio solve;
+* dual-iterate WARM SEEDING: iteration k+1 reseeds every window from
+  its iteration-k iterate even though the price shift moves every
+  float16-quantized digest feature (the PR-13 warm-start fix), with
+  measurably fewer inner iterations than round 0;
+* typed INFEASIBILITY (``PortfolioInfeasibleError`` with violated-row
+  diagnosis) instead of a non-converging dual loop, and the
+  ``diverging_duals`` fault drill (detect, rescale, still certify);
+* SERVICE integration: submit/metrics/spool round-trips, and a
+  load-shed degraded portfolio answer that is NEVER cert-stamped.
+"""
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from dervet_tpu.ops import warmstart
+from dervet_tpu.ops.certify import validate_portfolio_certification
+from dervet_tpu.portfolio import (COUPLING_LABEL, PortfolioInfeasibleError,
+                                  PortfolioSpec, monolithic_reference,
+                                  solve_portfolio,
+                                  validate_portfolio_section)
+from dervet_tpu.portfolio.service import (PortfolioRound,
+                                          parse_portfolio_request,
+                                          synthetic_portfolio_members)
+from dervet_tpu.utils import faultinject
+from dervet_tpu.utils.errors import ParameterError
+
+
+def _members(n=2, hours=48, window=24, seed=0, pv_kw=9000.0):
+    return synthetic_portfolio_members(n, hours=hours, window=window,
+                                       seed=seed, pv_kw=pv_kw)
+
+
+def _binding_cap(n=2, hours=48, window=24, margin=800.0):
+    """A shared export cap strictly below the fleet's unconstrained
+    aggregate peak — guaranteed binding."""
+    probe = solve_portfolio(
+        PortfolioSpec(members=_members(n, hours, window),
+                      export_cap_kw=1e9, max_outer=1), backend="cpu")
+    return float(probe.aggregate["net_export"].max()) - margin
+
+
+# ---------------------------------------------------------------------------
+# Spec validation
+# ---------------------------------------------------------------------------
+
+class TestSpec:
+    def test_requires_coupling(self):
+        with pytest.raises(ParameterError, match="no coupling"):
+            PortfolioSpec(members=_members(2)).validate()
+
+    def test_requires_two_sites(self):
+        m = _members(2)
+        one = {k: v for k, v in list(m.items())[:1]}
+        with pytest.raises(ParameterError, match=">= 2 sites"):
+            PortfolioSpec(members=one, export_cap_kw=1.0).validate()
+
+    def test_mismatched_horizons_rejected(self):
+        m = _members(2, hours=48)
+        m2 = _members(2, hours=72)
+        mixed = {"a": m["site000"], "b": m2["site001"]}
+        spec = PortfolioSpec(members=mixed, export_cap_kw=1e9)
+        with pytest.raises(ParameterError, match="horizon"):
+            solve_portfolio(spec, backend="cpu")
+
+    def test_profile_length_validated(self):
+        spec = PortfolioSpec(members=_members(2, hours=48),
+                             export_cap_kw=[1.0] * 7)
+        with pytest.raises(ParameterError, match="profile has length"):
+            solve_portfolio(spec, backend="cpu")
+
+
+# ---------------------------------------------------------------------------
+# Decomposition correctness vs the monolithic coupled LP
+# ---------------------------------------------------------------------------
+
+class TestDecomposition:
+    def test_two_site_matches_monolithic_to_1e6(self):
+        cap = _binding_cap()
+        spec = PortfolioSpec(members=_members(), export_cap_kw=cap,
+                             gap_tol=1e-9, feas_tol=1e-7, max_outer=60)
+        res = solve_portfolio(spec, backend="cpu")
+        mono = monolithic_reference(
+            PortfolioSpec(members=_members(), export_cap_kw=cap))
+        assert mono["status"] == 0
+        assert res.converged
+        rel = abs(res.primal_objective - mono["objective_cx"]) \
+            / (1.0 + abs(mono["objective_cx"]))
+        assert rel < 1e-6, (res.primal_objective, mono["objective_cx"])
+        # the cap genuinely binds (otherwise this test proves nothing)
+        assert res.certification["coupling_rows"]["export_cap"][
+            "binding"] > 0
+        # and the coupled optimum is strictly worse than uncoupled
+        probe = solve_portfolio(
+            PortfolioSpec(members=_members(), export_cap_kw=1e9,
+                          max_outer=1), backend="cpu")
+        assert res.primal_objective > probe.primal_objective + 1.0
+
+    def test_coupling_feasible_at_termination_float64(self):
+        cap = _binding_cap()
+        res = solve_portfolio(
+            PortfolioSpec(members=_members(), export_cap_kw=cap,
+                          gap_tol=1e-9, feas_tol=1e-7, max_outer=60),
+            backend="cpu")
+        # float64 re-check of the blended aggregate, independent of the
+        # engine's own bookkeeping
+        viol = np.maximum(res.aggregate["net_export"] - cap, 0.0)
+        assert float(viol.max()) <= 1e-6 * (1.0 + abs(cap))
+        cert = res.certification
+        validate_portfolio_certification(cert)
+        assert cert["verdict"] in ("certified", "certified_loose")
+        assert cert["inner_exact"] is True
+        assert cert["gap_rel"] <= 1e-9 * 10
+
+    def test_demand_charge_epigraph(self):
+        # a portfolio demand charge prices the PEAK aggregate import;
+        # the monolithic reference carries the same epigraph variable
+        spec = PortfolioSpec(members=_members(), export_cap_kw=1e9,
+                             demand_charge_per_kw=2.0,
+                             gap_tol=1e-6, max_outer=60)
+        res = solve_portfolio(spec, backend="cpu")
+        assert res.converged
+        peak_import = float(np.maximum(
+            -res.aggregate["net_export"], 0.0).max())
+        assert res.demand_charge_cost == pytest.approx(
+            2.0 * peak_import, rel=1e-4)
+        mono = monolithic_reference(
+            PortfolioSpec(members=_members(), export_cap_kw=1e9,
+                          demand_charge_per_kw=2.0))
+        rel = abs(res.primal_objective - mono["objective_cx"]) \
+            / (1.0 + abs(mono["objective_cx"]))
+        assert rel < 1e-5
+
+    def test_repeat_solve_byte_deterministic(self):
+        cap = _binding_cap()
+
+        def run():
+            return solve_portfolio(
+                PortfolioSpec(members=_members(), export_cap_kw=cap,
+                              gap_tol=1e-9, feas_tol=1e-7,
+                              max_outer=60), backend="cpu")
+
+        a, b = run(), run()
+        assert repr(a.primal_objective) == repr(b.primal_objective)
+        assert a.outer_rounds == b.outer_rounds
+        assert a.aggregate["net_export"].tobytes() == \
+            b.aggregate["net_export"].tobytes()
+        for kind in a.duals:
+            assert a.duals[kind].tobytes() == b.duals[kind].tobytes()
+        for key in a.site_solutions:
+            for name, arr in a.site_solutions[key].items():
+                assert arr.tobytes() == \
+                    b.site_solutions[key][name].tobytes(), (key, name)
+
+
+# ---------------------------------------------------------------------------
+# Dual-iterate warm seeding (jax backend)
+# ---------------------------------------------------------------------------
+
+class TestDualWarmSeeding:
+    @pytest.fixture(scope="class")
+    def coupled(self):
+        probe = solve_portfolio(
+            PortfolioSpec(members=_members(4, hours=336, window=168),
+                          export_cap_kw=1e9, max_outer=1),
+            backend="jax")
+        cap = float(probe.aggregate["net_export"].max()) - 2000.0
+        res = solve_portfolio(
+            PortfolioSpec(members=_members(4, hours=336, window=168),
+                          export_cap_kw=cap, max_outer=10),
+            backend="jax")
+        return probe, res
+
+    def test_rounds_after_first_are_dual_seeded(self, coupled):
+        _, res = coupled
+        assert res.converged
+        assert len(res.rounds) >= 2
+        for r in res.rounds[1:]:
+            assert r["seeded"] == r["windows"]
+            assert r["dual_iterate"] + r["substituted"] == r["windows"]
+
+    def test_seeded_rounds_cut_iterations(self, coupled):
+        probe, res = coupled
+        cold = probe.rounds[0]["iters_p50"]
+        late = [r["iters_p50"] for r in res.rounds[1:]]
+        assert min(late) < cold / 1.5
+        assert res.rounds[-1]["iters_p50"] < cold
+
+    def test_zero_compiles_after_round_one(self, coupled):
+        _, res = coupled
+        assert sum(r["compile_events"] for r in res.rounds[1:]) == 0
+
+    def test_all_site_windows_certified(self, coupled):
+        _, res = coupled
+        ps = res.certification["per_site"]
+        assert ps["all_certified"] and ps["windows_total"] > 0
+        validate_portfolio_section(res.run_health["portfolio"])
+        assert res.solve_ledger["portfolio"]["converged"]
+
+
+class TestDualIterateGrade:
+    """The PR-13 warm-start fix: a dual update's uniform price shift
+    moves every float16-quantized digest feature, so the near grade
+    degrades — the dedicated ``dual_iterate`` hint grade must carry the
+    reseeding instead."""
+
+    def _lp(self, shift=0.0):
+        from dervet_tpu.benchlib import synthetic_case
+        from dervet_tpu.scenario.scenario import MicrogridScenario
+        c = synthetic_case()
+        ts = c.datasets.time_series
+        c.datasets.time_series = ts.iloc[:48]
+        c.scenario["allow_partial_year"] = True
+        c.scenario["n"] = 24
+        s = MicrogridScenario(c)
+        ctx = s.windows[0]
+        lp = s.build_window_lp(ctx)
+        if shift:
+            # the dual update's signature: every power-term cost entry
+            # shifts by the (per-timestep) price — far past the float16
+            # digest's ~3-significant-digit resolution
+            lp.c = lp.c + shift
+        return s, lp
+
+    def test_price_shift_defeats_quant_digest_but_not_hint(self):
+        from dervet_tpu.ops.pdhg import PDHGOptions
+        s, lp0 = self._lp()
+        mem = warmstart.SolutionMemory(max_entries=16)
+        opts = PDHGOptions()
+        skey = ("struct",)
+        tag = warmstart.opts_tag(opts)
+        x = np.linspace(0.0, 1.0, lp0.n)
+        y = np.linspace(0.0, 0.5, lp0.m)
+        mem.store(skey, lp0, tag, x, y, 1.0)
+        mem.store_hint(("portfolio", "rid", "siteA", 0), x, y, 1.0)
+
+        _, lp1 = self._lp(shift=0.02)
+        # WITHOUT the hint: the quantized digest moved — no near hit
+        entry, kind, _, _ = mem.probe(skey, lp1, tag)
+        assert kind != "near"   # feature-fallback or miss, never near
+        # WITH the hint: the dedicated grade carries the reseed
+        lp1.seed_hint = ("portfolio", "rid", "siteA", 0)
+        plans = warmstart.plan_group(mem, skey, [lp1], opts, [0])
+        assert plans[0].kind == "dual_iterate"
+        assert plans[0].entry is not None
+        assert np.array_equal(plans[0].entry.x, x)
+        assert mem.stats["hits_dual"] >= 1
+
+    def test_exact_hit_outranks_hint(self):
+        from dervet_tpu.ops.pdhg import PDHGOptions
+        s, lp0 = self._lp()
+        mem = warmstart.SolutionMemory(max_entries=16)
+        opts = PDHGOptions()
+        tag = warmstart.opts_tag(opts)
+        x = np.zeros(lp0.n)
+        y = np.zeros(lp0.m)
+        mem.store(("k",), lp0, tag, x, y, 0.0)
+        mem.store_hint(("h",), x + 1.0, y, 0.0)
+        lp0.seed_hint = ("h",)
+        plans = warmstart.plan_group(mem, ("k",), [lp0], opts, [0])
+        assert plans[0].kind == "exact"
+
+    def test_kill_switch_restores_cold(self, monkeypatch):
+        monkeypatch.setenv("DERVET_TPU_WARMSTART", "0")
+        assert not warmstart.enabled()
+
+    def test_hint_table_bounded(self):
+        mem = warmstart.SolutionMemory(max_entries=4)
+        for i in range(12):
+            mem.store_hint(("h", i), np.zeros(3), np.zeros(2), 0.0)
+        assert mem.snapshot()["hint_entries"] <= 4
+        assert mem.lookup_hint(("h", 11)) is not None
+        assert mem.lookup_hint(("h", 0)) is None
+
+
+# ---------------------------------------------------------------------------
+# Fault matrix: coupling_infeasible + diverging_duals
+# ---------------------------------------------------------------------------
+
+class TestFaults:
+    def test_coupling_infeasible_typed_error(self):
+        # an aggregate import cap far below the fleet's must-serve load
+        spec = PortfolioSpec(members=_members(), import_cap_kw=500.0,
+                             max_outer=8)
+        with pytest.raises(PortfolioInfeasibleError) as ei:
+            solve_portfolio(spec, backend="cpu")
+        err = ei.value
+        assert err.kind == "portfolio_infeasible"
+        assert err.violations
+        worst = err.violations[0]
+        assert worst["kind"] == "import_cap"
+        assert worst["shortfall_kw"] > 0
+        assert "import_cap" in str(err)
+        # the typed record serializes for spool .error.json files
+        assert json.dumps(err.as_dict())
+
+    def test_infeasible_terminates_before_dual_loop(self):
+        spec = PortfolioSpec(members=_members(), import_cap_kw=500.0,
+                             max_outer=8)
+        calls = []
+        import dervet_tpu.portfolio.solve as psolve
+        orig = psolve.run_dispatch
+
+        def counting(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+
+        psolve.run_dispatch = counting
+        try:
+            with pytest.raises(PortfolioInfeasibleError):
+                solve_portfolio(spec, backend="cpu")
+        finally:
+            psolve.run_dispatch = orig
+        assert not calls    # pre-flight fired before any dispatch
+
+    def test_diverging_duals_detected_rescaled_certified(self):
+        probe = solve_portfolio(
+            PortfolioSpec(members=_members(4, hours=336, window=168),
+                          export_cap_kw=1e9, max_outer=1),
+            backend="jax")
+        cap = float(probe.aggregate["net_export"].max()) - 2000.0
+        with faultinject.inject(diverge_duals_round=1,
+                                diverge_duals_scale=25.0) as plan:
+            res = solve_portfolio(
+                PortfolioSpec(members=_members(4, hours=336,
+                                               window=168),
+                              export_cap_kw=cap, max_outer=14),
+                backend="jax")
+        assert ("diverging_duals", "1") in plan.fired
+        assert res.dual_rescales >= 1
+        assert any(r["regressed"] for r in res.rounds)
+        assert res.converged
+        assert res.certification["verdict"] in ("certified",
+                                                "certified_loose")
+
+
+# ---------------------------------------------------------------------------
+# Service integration
+# ---------------------------------------------------------------------------
+
+class TestService:
+    def test_submit_portfolio_round_trip_and_metrics(self):
+        from dervet_tpu.service import ScenarioService
+        svc = ScenarioService(backend="jax", max_wait_s=0.0)
+        try:
+            probe = svc.submit_portfolio(
+                PortfolioSpec(members=_members(4, hours=336,
+                                               window=168),
+                              export_cap_kw=1e9, max_outer=1),
+                request_id="pf-probe")
+            svc.run_once()
+            cap = float(probe.result(0).aggregate["net_export"].max()) \
+                - 2000.0
+            fut = svc.submit_portfolio(
+                PortfolioSpec(members=_members(4, hours=336,
+                                               window=168),
+                              export_cap_kw=cap, max_outer=10),
+                request_id="pf-bind")
+            served = svc.run_once()
+            res = fut.result(0)
+            assert served == 1 and res.converged
+            assert res.fidelity == "certified"
+            m = svc.metrics()["portfolio"]
+            assert m["requests"] == 2
+            assert m["dual_iterate_seeds"] > 0
+            validate_portfolio_section(m["last"])
+        finally:
+            svc.close()
+
+    def test_infeasible_request_answers_typed(self):
+        from dervet_tpu.service import ScenarioService
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0)
+        try:
+            fut = svc.submit_portfolio(
+                PortfolioSpec(members=_members(), import_cap_kw=500.0,
+                              max_outer=5),
+                request_id="pf-bad")
+            svc.run_once()
+            with pytest.raises(PortfolioInfeasibleError):
+                fut.result(0)
+            assert svc.metrics()["portfolio"]["infeasible"] == 1
+        finally:
+            svc.close()
+
+    def test_shed_degraded_portfolio_never_cert_stamped(self):
+        from dervet_tpu.service.queue import QueuedRequest
+        spec = PortfolioSpec(members=_members(4, hours=336, window=168),
+                             export_cap_kw=-800.0 * 4, max_outer=4)
+        req = QueuedRequest("pf-shed", {}, kind="portfolio")
+        req.portfolio_spec = spec
+        rnd = PortfolioRound([req], backend="jax",
+                             degraded_ids={"pf-shed"})
+        rnd.run()
+        res = req.future.result(0)
+        assert res.fidelity == "degraded"
+        assert res.resubmit_hint
+        cert = res.certification
+        assert cert["enabled"] is False
+        assert cert["verdict"] == "not_certified"
+        assert res.run_health["fidelity"] == "degraded"
+        assert rnd.stats["degraded"] == 1
+
+    def test_spool_round_trip(self, tmp_path):
+        from dervet_tpu.service.server import serve_main
+        spool = tmp_path / "spool"
+        (spool / "incoming").mkdir(parents=True)
+        payload = {"portfolio": {
+            "synthetic_members": {"sites": 2, "hours": 48,
+                                  "window": 24},
+            "export_cap_kw": _binding_cap(),
+            "gap_tol": 5e-3,
+            "max_outer": 40,
+        }}
+        (spool / "incoming" / "pfreq.json").write_text(
+            json.dumps(payload))
+        rc = serve_main([str(spool), "--backend", "cpu", "--once",
+                         "--heartbeat-s", "0",
+                         "--memory-export-s", "0"])
+        assert rc == 0
+        out = spool / "results" / "pfreq" / "portfolio.json"
+        assert out.exists()
+        rec = json.loads(out.read_text())
+        assert rec["converged"]
+        assert rec["certification"]["verdict"] in ("certified",
+                                                   "certified_loose")
+        assert (spool / "results" / "pfreq"
+                / "portfolio_aggregate.csv").exists()
+        assert (spool / "done" / "pfreq.json").exists()
+
+    def test_parse_portfolio_request_validation(self):
+        with pytest.raises(ParameterError, match="members"):
+            parse_portfolio_request({"portfolio": {}})
+        spec = parse_portfolio_request({"portfolio": {
+            "synthetic_members": {"sites": 2, "hours": 48,
+                                  "window": 24},
+            "export_cap_kw": 100.0}})
+        assert len(spec.members) == 2
+        assert spec.export_cap_kw == 100.0
+
+
+# ---------------------------------------------------------------------------
+# Objective-component integrity under the price shift
+# ---------------------------------------------------------------------------
+
+class TestCouplingComponent:
+    def test_breakdown_carries_coupling_label_and_reconciles(self):
+        cap = _binding_cap()
+        res = solve_portfolio(
+            PortfolioSpec(members=_members(), export_cap_kw=cap,
+                          gap_tol=1e-9, feas_tol=1e-7, max_outer=60),
+            backend="cpu")
+        assert any(res.duals["export_cap"] > 0)
+        # true cost excludes the coupling-price component: the blend's
+        # true cost must match the master objective exactly
+        assert res.objective_cx == pytest.approx(
+            res.primal_objective, abs=1e-9)
