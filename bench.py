@@ -619,6 +619,12 @@ def serving_leg() -> dict:
     DERVET.from_cases(request_cases(1)).solve(backend="jax")
     t_cold = time.time() - t0
 
+    # telemetry (dervet_tpu/telemetry): reset the process registry so
+    # the published snapshot covers THIS leg's serving alone
+    from dervet_tpu.telemetry import registry as telemetry_registry
+    if telemetry_registry.enabled():
+        telemetry_registry.get_registry().reset()
+
     svc = ScenarioService(backend="jax", max_wait_s=0.05)
     svc.start()
     try:
@@ -643,6 +649,8 @@ def serving_leg() -> dict:
         t_load = time.time() - t0
         m = svc.metrics()
         check_kernel_gate(svc.last_round_ledger, "serving")
+        telem_snap = (telemetry_registry.get_registry().snapshot()
+                      if telemetry_registry.enabled() else None)
     finally:
         svc.close()
 
@@ -667,8 +675,30 @@ def serving_leg() -> dict:
         f"warm-beats-cold gate: {'OK' if ok else 'FAIL'}")
     if not ok:
         raise SystemExit(6)
+    # registry snapshot published + schema-validated alongside the solve
+    # ledger (the telemetry plane's bench surface); the histogram p50 is
+    # cross-checked against the directly-measured latencies — the merge
+    # math must agree with reality within the log-bucket resolution
+    telemetry = None
+    if telem_snap is not None:
+        from dervet_tpu.benchlib import validate_telemetry_section
+        from dervet_tpu.telemetry.registry import quantile_from_buckets
+        validate_telemetry_section(telem_snap)
+        hist = telem_snap["histograms"].get(
+            "dervet_request_latency_seconds")
+        hist_p50 = (quantile_from_buckets(hist, 0.5) if hist else None)
+        if hist_p50 is not None and p50 > 0 and \
+                not (p50 / 2.5 <= hist_p50 <= p50 * 2.5):
+            raise SystemExit(
+                f"bench[serving]: telemetry histogram p50 {hist_p50:.4f}s"
+                f" disagrees with measured p50 {p50:.4f}s beyond the "
+                "log-bucket resolution")
+        telemetry = {**telem_snap,
+                     "latency_hist_p50_s": (round(hist_p50, 4)
+                                            if hist_p50 else None)}
     return {
         "requests": n_load,
+        "telemetry": telemetry,
         "cases": total_cases,
         "cold_solve_single_case_s": round(t_cold, 3),
         "service_first_request_s": round(t_first, 3),
@@ -719,6 +749,12 @@ def serving_elastic_leg() -> dict:
 
     from dervet_tpu.benchlib import synthetic_sensitivity_cases
     from dervet_tpu.service import ScenarioService
+    from dervet_tpu.telemetry import registry as telemetry_registry
+
+    # the published snapshot must cover THIS leg alone (earlier legs in
+    # the same bench process accumulate into the process registry)
+    if telemetry_registry.enabled():
+        telemetry_registry.get_registry().reset()
 
     n_dev = len(jax.devices())
     platform = jax.devices()[0].platform
@@ -870,7 +906,21 @@ def serving_elastic_leg() -> dict:
         "serial_scheduler_within_tolerance": serial_close,
         "gates": gates,
         "gated_on_real_mesh": real_mesh,
+        # registry snapshot (accumulated over the three passes),
+        # schema-validated like the solve ledger
+        "telemetry": _telemetry_section(),
     }
+
+
+def _telemetry_section():
+    """The process metrics-registry snapshot, schema-validated, for a
+    serving leg's published artifact (None under the kill switch)."""
+    from dervet_tpu.benchlib import validate_telemetry_section
+    from dervet_tpu.telemetry import registry as telemetry_registry
+    if not telemetry_registry.enabled():
+        return None
+    return validate_telemetry_section(
+        telemetry_registry.get_registry().snapshot())
 
 
 def solver_core_leg() -> dict:

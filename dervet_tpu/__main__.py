@@ -4,7 +4,10 @@ the persistent scenario service instead (service.server.serve_main);
 ``dervet-tpu design CASE --bounds ...`` runs a one-shot BOOST sizing
 frontier (design.cli.design_main); ``dervet-tpu portfolio REQ.json``
 runs a one-shot coupled-portfolio co-optimization
-(portfolio.cli.portfolio_main)."""
+(portfolio.cli.portfolio_main); ``dervet-tpu status SPOOL_DIR`` renders
+live fleet health from the published telemetry and ``dervet-tpu trace
+RID DIR`` stitches + pretty-prints one request's span tree
+(telemetry.ops)."""
 from __future__ import annotations
 
 import argparse
@@ -30,6 +33,18 @@ def main(argv=None):
         # 75 preempted, 2 infeasible)
         from .portfolio.cli import portfolio_main
         raise SystemExit(portfolio_main(argv[1:]))
+    if argv and argv[0] == "status":
+        # live fleet health from replica-published telemetry expositions
+        # (telemetry/ops.py): replicas, breakers, queue depths, warm hit
+        # rates, merged latency percentiles, SLO attainment
+        from .telemetry.ops import status_main
+        raise SystemExit(status_main(argv[1:]))
+    if argv and argv[0] == "trace":
+        # stitch and pretty-print one request's span tree across the
+        # router + replica exports (slowest path highlighted; --chrome
+        # writes a chrome://tracing / Perfetto timeline)
+        from .telemetry.ops import trace_main
+        raise SystemExit(trace_main(argv[1:]))
 
     from .api import DERVET
 

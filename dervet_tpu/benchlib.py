@@ -208,6 +208,50 @@ def validate_solve_ledger(ledger: Dict) -> Dict:
     return ledger
 
 
+def validate_telemetry_section(snap: Dict) -> Dict:
+    """Schema-check a telemetry registry snapshot
+    (``MetricsRegistry.snapshot()``) before it is published in a BENCH
+    artifact: the fixed histogram layout (the cross-replica merge
+    contract), internally consistent bucket counts, and numeric
+    counter/gauge values.  Raises ``ValueError`` naming the violation;
+    returns the snapshot unchanged so callers can chain it."""
+    from dervet_tpu.telemetry import registry as _registry
+    if not isinstance(snap, dict):
+        raise ValueError(f"telemetry section must be a dict, "
+                         f"got {type(snap)}")
+    for k in ("counters", "gauges", "histograms", "hist_bounds", "t"):
+        if k not in snap:
+            raise ValueError(f"telemetry section missing {k!r}")
+    if int(snap["hist_bounds"]) != len(_registry.HIST_BOUNDS):
+        raise ValueError(
+            f"telemetry hist_bounds {snap['hist_bounds']} != the fixed "
+            f"layout's {len(_registry.HIST_BOUNDS)} — merges across "
+            "replicas would be wrong")
+    for name, v in snap["counters"].items():
+        if not isinstance(v, (int, float)) or v < 0:
+            raise ValueError(f"telemetry counter {name!r} not a "
+                             f"non-negative number: {v!r}")
+    for name, v in snap["gauges"].items():
+        if not isinstance(v, (int, float)):
+            raise ValueError(f"telemetry gauge {name!r} not numeric: "
+                             f"{v!r}")
+    for name, h in snap["histograms"].items():
+        for k in ("count", "sum", "buckets", "overflow"):
+            if k not in h:
+                raise ValueError(f"telemetry histogram {name!r} "
+                                 f"missing {k!r}")
+        if len(h["buckets"]) != len(_registry.HIST_BOUNDS):
+            raise ValueError(
+                f"telemetry histogram {name!r} has {len(h['buckets'])} "
+                f"buckets, expected {len(_registry.HIST_BOUNDS)}")
+        if sum(h["buckets"]) + h["overflow"] != h["count"]:
+            raise ValueError(
+                f"telemetry histogram {name!r} bucket counts "
+                f"({sum(h['buckets'])} + {h['overflow']} overflow) do "
+                f"not sum to count {h['count']}")
+    return snap
+
+
 def build_window_lps(case: CaseParams, pad_to_max: bool = False
                      ) -> Tuple[MicrogridScenario, Dict[int, List[LP]]]:
     """Assemble every optimization window's LP, grouped by window length.

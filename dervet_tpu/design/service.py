@@ -32,6 +32,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..io.params import Params
+from ..telemetry import trace as telemetry_trace
 from ..utils.errors import (DeadlineExpiredError, ParameterError,
                             PreemptedError, RequestFailedError,
                             RequestPreemptedError, TellUser)
@@ -138,6 +139,14 @@ class DesignRound:
             req.future.set_exception(exc)
         self.answered.append(req)
 
+    @staticmethod
+    def _restore_request_span(req) -> None:
+        """Point the rid registry back at the request root span once the
+        screen span ended (the certified round's spans parent right)."""
+        root = getattr(req, "span", None)
+        if root is not None:
+            telemetry_trace.register_request(req.request_id, root)
+
     def _preempt_all(self, pending, e) -> None:
         """Drain signal mid-screening: every unanswered design request
         (current and not-yet-screened) gets the typed resumable answer
@@ -161,6 +170,16 @@ class DesignRound:
             spec: DesignSpec = req.design_spec
             case = req.design_case
             t0 = time.monotonic()
+            # telemetry: the screening tiers run under one design_screen
+            # span; the per-tier dispatch-group spans parent under it
+            # via the rid registry (re-pointed here, restored after)
+            span = telemetry_trace.start_span(
+                "design_screen", rid=req.request_id,
+                attrs={"backend": self.backend,
+                       "refine_rounds": spec.refine_rounds,
+                       "top_k": spec.top_k})
+            if span:
+                telemetry_trace.register_request(req.request_id, span)
             try:
                 candidates = generate_population(spec)
                 report = screen_candidates(
@@ -171,9 +190,12 @@ class DesignRound:
                     budget=spec.budget, supervisor=self.supervisor,
                     request_id=req.request_id)
             except PreemptedError as e:
+                span.end(error=e)
                 self._preempt_all(self.requests[i:], e)
                 raise
             except Exception as e:
+                span.end(error=e)
+                self._restore_request_span(req)
                 TellUser.error(f"design request {req.request_id}: "
                                f"screening failed: {e}")
                 self._answer(req, e)
@@ -191,6 +213,24 @@ class DesignRound:
                 "dispatches": report.dispatches,
             }
             finalists = report.top(spec.top_k)
+            if span:
+                degraded = req.request_id in self.degraded_ids
+                span.set_attrs({
+                    "candidates": len(report.entries),
+                    "screen_rounds": len(report.rounds),
+                    "screen_s": round(report.screen_s, 4),
+                    "compile_events": report.compile_events,
+                    "finalists": len(finalists),
+                    "fidelity": (FIDELITY_DEGRADED if degraded
+                                 else "certified"),
+                })
+                if degraded:
+                    span.event("load_shed",
+                               reason="design answered from the screen "
+                                      "alone — degraded frontier")
+                span.end(error=(None if finalists
+                                else "no candidate survived screening"))
+                self._restore_request_span(req)
             if not finalists:
                 reasons = {e.candidate.index: e.reason
                            for e in report.entries if e.reason}

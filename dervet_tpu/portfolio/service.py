@@ -24,6 +24,7 @@ import hashlib
 import time
 from typing import Dict, List, Optional
 
+from ..telemetry import trace as telemetry_trace
 from ..utils.errors import (DeadlineExpiredError, ParameterError,
                             PreemptedError, RequestPreemptedError,
                             TellUser)
@@ -89,6 +90,19 @@ class PortfolioRound:
             cache = (self.degraded_cache if degraded
                      else self.solver_cache)
             t0 = time.monotonic()
+            # telemetry: the outer dual loop is one span; the inner
+            # dispatch-group spans parent under it via the rid registry
+            # (re-pointed here, restored when it ends)
+            span = telemetry_trace.start_span(
+                "portfolio_dual_loop", rid=req.request_id,
+                attrs={"backend": self.backend, "degraded": degraded,
+                       "members": len(req.portfolio_spec.members)})
+            if span:
+                telemetry_trace.register_request(req.request_id, span)
+                if degraded:
+                    span.event("load_shed",
+                               reason="portfolio answered by the "
+                                      "degraded screening tier")
             try:
                 result = solve_portfolio(
                     req.portfolio_spec, backend=self.backend,
@@ -97,14 +111,18 @@ class PortfolioRound:
                     breaker_board=self.board,
                     request_id=req.request_id, degraded=degraded)
             except PreemptedError as e:
+                span.end(error=e)
                 self._preempt_all(self.requests[i:], e)
                 raise
             except Exception as e:
                 from ..utils.errors import PortfolioInfeasibleError
                 if isinstance(e, PortfolioInfeasibleError):
                     self.stats["infeasible"] += 1
+                    span.event("coupling_infeasible")
                 else:
                     self.stats["failed"] += 1
+                span.end(error=e)
+                self._restore_request_span(req)
                 TellUser.error(f"portfolio request {req.request_id}: "
                                f"{type(e).__name__}: {e}")
                 req.future.set_exception(e)
@@ -120,9 +138,28 @@ class PortfolioRound:
             if degraded:
                 self.stats["degraded"] += 1
             self.last_portfolio = result.portfolio_section()
+            if span:
+                span.set_attrs({
+                    "outer_rounds": result.outer_rounds,
+                    "windows": sum(r.get("windows", 0)
+                                   for r in result.rounds),
+                    "dual_iterate_seeds": sum(r.get("dual_iterate", 0)
+                                              for r in result.rounds),
+                    "gap": self.last_portfolio.get("gap"),
+                })
+                span.end()
+                self._restore_request_span(req)
             result.request_latency_s = time.monotonic() - req.t_submit
             req.future.set_result(result)
             self.answered.append(req)
+
+    @staticmethod
+    def _restore_request_span(req) -> None:
+        """Point the rid registry back at the request root span once the
+        dual-loop span ended (delivery-time spans parent correctly)."""
+        root = getattr(req, "span", None)
+        if root is not None:
+            telemetry_trace.register_request(req.request_id, root)
 
 
 # ---------------------------------------------------------------------------

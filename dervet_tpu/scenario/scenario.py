@@ -29,6 +29,7 @@ from ..models.streams.da import DAEnergyTimeShift
 from ..models.streams.markets import TILT_LABEL
 from ..ops.lp import LP, LPBuilder
 from ..ops import certify, cpu_ref
+from ..telemetry import trace as telemetry_trace
 from ..utils import faultinject
 from ..utils.errors import (AggregatedSolverError, MonthlyDataError,
                             ParameterError, SolverError, TellUser,
@@ -2012,137 +2013,210 @@ def resolve_group(items, backend: str, solver_opts, key=None,
                     if getattr(s, "request_id", None) is not None})
     if _reqs:
         meta["requests"] = _reqs
-    # explicit policy wins (the dispatch driver captures it once on the
-    # dispatching thread, where a thread-local override may be active —
-    # pool workers would otherwise read their own, un-overridden env)
-    policy = policy if policy is not None else certify.policy_from_env()
-    # the dual block leaves the device ONLY when the certification policy
-    # asks for dual-side verification (DERVET_TPU_CERT_DUAL=1)
-    y_box: Optional[dict] = ({} if (policy.enabled and policy.check_dual
-                                    and backend != "cpu") else None)
-    # the watchdog may ABANDON a wedged solve on a daemon thread; handing
-    # solve_group the shared ledger would let that zombie append a
-    # full-wall entry after the deadline cut dispatch_solve_s short (or
-    # after the summary already ran) — so solves write to a PRIVATE list
-    # merged only on a non-timed-out return
-    local_ledger = [] if ledger is not None else None
-    # last-iterate sink: the retry rung seeds its re-solve from the
-    # failed members' final iterates (x from the returned lists, y
-    # fetched lazily off the device handle captured here)
-    iterate_sink: dict = {}
+    # telemetry (dervet_tpu/telemetry): one dispatch_group span per
+    # request that rode this group, parented via the request registry
+    # (this may run on any elastic worker thread) — the group's solve-
+    # ledger entry becomes the span's attribute payload at the end, and
+    # the elastic device/stolen tags give the Chrome trace export its
+    # per-device occupancy lanes
+    _tspans: list = []
+    if _reqs and telemetry_trace.enabled():
+        for _rid in _reqs:
+            _sp = telemetry_trace.start_span(
+                "dispatch_group", rid=_rid,
+                attrs={"windows": len(items), "requests": _reqs,
+                       **(ledger_tags or {})})
+            if _sp:
+                _tspans.append(_sp)
+    try:
+        # explicit policy wins (the dispatch driver captures it once on the
+        # dispatching thread, where a thread-local override may be active —
+        # pool workers would otherwise read their own, un-overridden env)
+        policy = policy if policy is not None else certify.policy_from_env()
+        # the dual block leaves the device ONLY when the certification policy
+        # asks for dual-side verification (DERVET_TPU_CERT_DUAL=1)
+        y_box: Optional[dict] = ({} if (policy.enabled and policy.check_dual
+                                        and backend != "cpu") else None)
+        # the watchdog may ABANDON a wedged solve on a daemon thread; handing
+        # solve_group the shared ledger would let that zombie append a
+        # full-wall entry after the deadline cut dispatch_solve_s short (or
+        # after the summary already ran) — so solves write to a PRIVATE list
+        # merged only on a non-timed-out return
+        local_ledger = [] if ledger is not None else None
+        # last-iterate sink: the retry rung seeds its re-solve from the
+        # failed members' final iterates (x from the returned lists, y
+        # fetched lazily off the device handle captured here)
+        iterate_sink: dict = {}
 
-    def _call():
-        # hang/slow faults sleep INSIDE the guarded closure, exactly
-        # where a wedged device call would be observed; device_loss
-        # raises from the same spot a real XlaRuntimeError would
-        faultinject.maybe_device_loss()
-        faultinject.maybe_sleep(labels, faultinject.RUNG_SOLVE)
-        return solve_group(lps[0], lps, backend, solver_opts, key=key,
-                           cache=cache, labels=labels, staged=staged,
-                           ledger=local_ledger, ledger_meta=meta,
-                           y_sink=y_box, iterate_sink=iterate_sink,
-                           device=device)
+        def _call():
+            # hang/slow faults sleep INSIDE the guarded closure, exactly
+            # where a wedged device call would be observed; device_loss
+            # raises from the same spot a real XlaRuntimeError would
+            faultinject.maybe_device_loss()
+            faultinject.maybe_sleep(labels, faultinject.RUNG_SOLVE)
+            return solve_group(lps[0], lps, backend, solver_opts, key=key,
+                               cache=cache, labels=labels, staged=staged,
+                               ledger=local_ledger, ledger_meta=meta,
+                               y_sink=y_box, iterate_sink=iterate_sink,
+                               device=device)
 
-    (xs, objs, ok, diags, statuses), timed_out = _guarded_solve(
-        watchdog, "initial", lps, labels, _call)
-    if timed_out:
-        _count_watchdog_timeout(items, range(len(items)))
-    elif ledger is not None:
-        ledger.extend(local_ledger)
-    plan = faultinject.get_plan()
-    if plan is not None:
-        for i, (s, ctx, lp) in enumerate(items):
-            if ok[i] and plan.force_nonconverge(ctx.label,
-                                                faultinject.RUNG_SOLVE):
-                ok[i] = False
-                statuses[i] = STATUS_ITER_LIMIT
-                diags[i] = ("fault injection: forced non-convergence at "
-                            "rung 'solve'")
-        # corrupt_solution fires AFTER the solver's verdict: the solve
-        # still reports success, only the numbers are wrong — the shape
-        # of failure only the independent certifier below can catch
-        for i, (s, ctx, lp) in enumerate(items):
-            if ok[i]:
-                bad = faultinject.maybe_corrupt(ctx.label, xs[i],
-                                                faultinject.RUNG_SOLVE, plan)
-                if bad is not None:
-                    xs[i] = bad
-    # ---- independent float64 certification of every accepted solution
-    # (ops/certify.py): a certificate rejection drops the member into the
-    # escalation ladder exactly like a solver failure — today's ladder
-    # only fires on solver STATUS, so a wrong-but-"OPTIMAL" solution
-    # would otherwise never be retried
-    cert_rejected: set = set()
-    if policy.enabled:
-        ys = y_box.get("y") if y_box else None
-        if ys is not None and np.ndim(ys) == 1:
-            ys = ys[None]
-        for i, (s, ctx, lp) in enumerate(items):
-            if not ok[i] or (lp.integrality is not None
-                             and backend != "cpu"):
-                # binary relaxations on an accelerated backend are
-                # provisional — apply_subgroup certifies their FINAL x
-                continue
-            cert = _certify_and_record(
-                s, ctx.label, lp, xs[i], objs[i], policy,
-                y=(ys[i] if ys is not None else None))
-            if board is not None:
-                board.record("certify", cert.accepted)
-            if not cert.accepted:
-                ok[i] = False
-                cert_rejected.add(i)
-                diags[i] = f"{certify.REJECT_DIAG_PREFIX} {cert.reason}"
-                # drop any warm-start memory entry for this exact data:
-                # a rejected solution the memory vouched for would be
-                # re-substituted, re-rejected, and re-escalated on every
-                # repeat request otherwise
-                mem = getattr(cache, "memory", None) \
-                    if cache is not None else None
-                if mem is not None and key is not None:
-                    mem.invalidate(key, lp, np.dtype(
-                        (solver_opts or PDHGOptions()).dtype))
-                TellUser.warning(
-                    f"window {ctx.label}: solver-accepted solution "
-                    f"REJECTED by the float64 certifier ({cert.reason}); "
-                    "escalating")
-    fail_idx = [i for i in range(len(items)) if not ok[i]]
-    with _health_lock:
-        for i, (s, ctx, lp) in enumerate(items):
-            # binary windows on an accelerated backend are counted in
-            # apply_subgroup instead: their relaxation's convergence here
-            # is provisional — the binary-feasibility check / exact-MILP
-            # rescue there decides the window's final bucket
-            if lp.integrality is not None and backend != "cpu":
-                continue
-            if ok[i]:
-                s.health["inaccurate" if statuses[i] == STATUS_INACCURATE
-                         else "clean"] += 1
-    if fail_idx:
-        _escalate(items, fail_idx, xs, objs, ok, diags, statuses,
-                  backend, solver_opts, key, cache, watchdog, ledger=ledger,
-                  policy=policy, cert_rejected=cert_rejected, board=board,
-                  iterate_sink=iterate_sink, device=device,
-                  ledger_tags=ledger_tags)
-    if policy.enabled and cert_rejected:
-        # windows whose LAST certificate still rejected after the full
-        # ladder: counted here (their case quarantines in apply_subgroup)
+        (xs, objs, ok, diags, statuses), timed_out = _guarded_solve(
+            watchdog, "initial", lps, labels, _call)
+        if timed_out:
+            _count_watchdog_timeout(items, range(len(items)))
+        elif ledger is not None:
+            ledger.extend(local_ledger)
+        plan = faultinject.get_plan()
+        if plan is not None:
+            for i, (s, ctx, lp) in enumerate(items):
+                if ok[i] and plan.force_nonconverge(ctx.label,
+                                                    faultinject.RUNG_SOLVE):
+                    ok[i] = False
+                    statuses[i] = STATUS_ITER_LIMIT
+                    diags[i] = ("fault injection: forced non-convergence at "
+                                "rung 'solve'")
+            # corrupt_solution fires AFTER the solver's verdict: the solve
+            # still reports success, only the numbers are wrong — the shape
+            # of failure only the independent certifier below can catch
+            for i, (s, ctx, lp) in enumerate(items):
+                if ok[i]:
+                    bad = faultinject.maybe_corrupt(ctx.label, xs[i],
+                                                    faultinject.RUNG_SOLVE, plan)
+                    if bad is not None:
+                        xs[i] = bad
+        # ---- independent float64 certification of every accepted solution
+        # (ops/certify.py): a certificate rejection drops the member into the
+        # escalation ladder exactly like a solver failure — today's ladder
+        # only fires on solver STATUS, so a wrong-but-"OPTIMAL" solution
+        # would otherwise never be retried
+        cert_rejected: set = set()
+        _t_cert_wall, _t_cert_mono = time.time(), time.monotonic()
+        _n_certified = 0
+        if policy.enabled:
+            ys = y_box.get("y") if y_box else None
+            if ys is not None and np.ndim(ys) == 1:
+                ys = ys[None]
+            for i, (s, ctx, lp) in enumerate(items):
+                if not ok[i] or (lp.integrality is not None
+                                 and backend != "cpu"):
+                    # binary relaxations on an accelerated backend are
+                    # provisional — apply_subgroup certifies their FINAL x
+                    continue
+                cert = _certify_and_record(
+                    s, ctx.label, lp, xs[i], objs[i], policy,
+                    y=(ys[i] if ys is not None else None))
+                _n_certified += 1
+                if board is not None:
+                    board.record("certify", cert.accepted)
+                if not cert.accepted:
+                    ok[i] = False
+                    cert_rejected.add(i)
+                    diags[i] = f"{certify.REJECT_DIAG_PREFIX} {cert.reason}"
+                    # drop any warm-start memory entry for this exact data:
+                    # a rejected solution the memory vouched for would be
+                    # re-substituted, re-rejected, and re-escalated on every
+                    # repeat request otherwise
+                    mem = getattr(cache, "memory", None) \
+                        if cache is not None else None
+                    if mem is not None and key is not None:
+                        mem.invalidate(key, lp, np.dtype(
+                            (solver_opts or PDHGOptions()).dtype))
+                    TellUser.warning(
+                        f"window {ctx.label}: solver-accepted solution "
+                        f"REJECTED by the float64 certifier ({cert.reason}); "
+                        "escalating")
+        if _tspans and policy.enabled and _n_certified:
+            # retro certify span: the float64 certification pass this group
+            # just ran, as a timed child of each request's group span
+            _cert_dur = time.monotonic() - _t_cert_mono
+            for _sp in _tspans:
+                telemetry_trace.start_span(
+                    "certify", parent=_sp, t_start=_t_cert_wall,
+                    duration_s=_cert_dur,
+                    attrs={"checked": _n_certified,
+                           "rejected": len(cert_rejected)})
+        fail_idx = [i for i in range(len(items)) if not ok[i]]
         with _health_lock:
-            for i in cert_rejected:
-                if not ok[i]:
-                    _certification_of(items[i][0])["rejected_final"] += 1
-    # deterministic shadow-solve drift sample, AFTER the ladder so a
-    # sampled window that was cert-rejected-then-recovered still gets its
-    # cross-check (the drill runs are exactly where it matters most).
-    # Skipped on the cpu backend (the shadow would re-run the identical
-    # solver) and for binary windows (their accepted value here is the
-    # LP relaxation — comparing it against the exact MILP would record
-    # the integrality gap as phantom solver drift).
-    if policy.enabled and backend != "cpu":
-        for i, (s, ctx, lp) in enumerate(items):
-            if ok[i] and lp.integrality is None and \
-                    ctx.label in getattr(s, "_shadow_labels", ()):
-                _shadow_solve(s, ctx.label, lp, objs[i], policy)
-    return xs, objs, ok, diags
+            for i, (s, ctx, lp) in enumerate(items):
+                # binary windows on an accelerated backend are counted in
+                # apply_subgroup instead: their relaxation's convergence here
+                # is provisional — the binary-feasibility check / exact-MILP
+                # rescue there decides the window's final bucket
+                if lp.integrality is not None and backend != "cpu":
+                    continue
+                if ok[i]:
+                    s.health["inaccurate" if statuses[i] == STATUS_INACCURATE
+                             else "clean"] += 1
+        if fail_idx:
+            for _sp in _tspans:
+                _sp.event("escalate", failed=len(fail_idx),
+                          cert_rejected=len(cert_rejected),
+                          timed_out=bool(timed_out))
+            _escalate(items, fail_idx, xs, objs, ok, diags, statuses,
+                      backend, solver_opts, key, cache, watchdog, ledger=ledger,
+                      policy=policy, cert_rejected=cert_rejected, board=board,
+                      iterate_sink=iterate_sink, device=device,
+                      ledger_tags=ledger_tags)
+            for _sp in _tspans:
+                _sp.event("escalation_done",
+                          recovered=sum(1 for i in fail_idx if ok[i]),
+                          unrecovered=sum(1 for i in fail_idx if not ok[i]))
+        if policy.enabled and cert_rejected:
+            # windows whose LAST certificate still rejected after the full
+            # ladder: counted here (their case quarantines in apply_subgroup)
+            with _health_lock:
+                for i in cert_rejected:
+                    if not ok[i]:
+                        _certification_of(items[i][0])["rejected_final"] += 1
+        # deterministic shadow-solve drift sample, AFTER the ladder so a
+        # sampled window that was cert-rejected-then-recovered still gets its
+        # cross-check (the drill runs are exactly where it matters most).
+        # Skipped on the cpu backend (the shadow would re-run the identical
+        # solver) and for binary windows (their accepted value here is the
+        # LP relaxation — comparing it against the exact MILP would record
+        # the integrality gap as phantom solver drift).
+        if policy.enabled and backend != "cpu":
+            for i, (s, ctx, lp) in enumerate(items):
+                if ok[i] and lp.integrality is None and \
+                        ctx.label in getattr(s, "_shadow_labels", ()):
+                    _shadow_solve(s, ctx.label, lp, objs[i], policy)
+        if _tspans:
+            # the ledger entry IS the span attribute payload (tentpole's
+            # reuse contract) — minus the private per-window arrays; a
+            # watchdog-abandoned solve merged no entry, so the span keeps
+            # its construction-time attrs and an error status instead
+            _entry = (local_ledger[0]
+                      if local_ledger and not timed_out else None)
+            _attrs = _span_attrs_from_entry(_entry) if _entry else {}
+            _err = ("watchdog timeout" if timed_out else None)
+            for _sp in _tspans:
+                _sp.set_attrs(_attrs)
+                _sp.set_attr("ok_windows", int(sum(bool(o) for o in ok)))
+                _sp.end(error=_err)
+        return xs, objs, ok, diags
+    except BaseException as _exc:
+        # raising paths propagate out of the batcher round (device
+        # loss, AggregatedSolverError, preemption): end the group
+        # spans here or the failed request's exported trace loses
+        # its dispatch record (and the escalate event already on it)
+        for _sp in _tspans:
+            _sp.end(error=_exc)
+        raise
+
+
+def _span_attrs_from_entry(entry: Dict) -> Dict:
+    """A solve-ledger group entry as span-attribute payload: everything
+    JSON-sized, dropping the private per-window iteration arrays."""
+    out: Dict = {}
+    for k, v in entry.items():
+        if k.startswith("_") or isinstance(v, np.ndarray):
+            continue
+        if k == "warm" and isinstance(v, dict):
+            out[k] = {wk: wv for wk, wv in v.items()
+                      if not wk.startswith("_")}
+        else:
+            out[k] = v
+    return out
 
 
 def _escalate(items, fail_idx, xs, objs, ok, diags, statuses, backend,

@@ -27,6 +27,7 @@ from ..io.summary import run_health_report
 from ..ops.certify import aggregate_audits
 from ..results.result import Result
 from ..scenario.scenario import MicrogridScenario, run_dispatch
+from ..telemetry import trace as telemetry_trace
 from ..utils.errors import (AggregatedSolverError, PoisonRequestError,
                             PreemptedError, TellUser)
 from . import resilience
@@ -196,6 +197,9 @@ class BatchRound:
         # id / assembly failure) — kept so the service's request
         # accounting still covers them
         self.answered_early: List[QueuedRequest] = []
+        # telemetry: per-request batch_round spans (ended in
+        # _finish_stats, which every exit path reaches exactly once)
+        self._round_spans: Dict[str, object] = {}
 
     # ------------------------------------------------------------------
     def _build_scenarios(self) -> List[MicrogridScenario]:
@@ -342,6 +346,7 @@ class BatchRound:
             backend = "cpu"
         self.backend_used = backend
         all_scens = self._build_scenarios()
+        self._start_round_spans(breaker_reroute=(backend != self.backend))
         if not all_scens:
             self._finish_stats(all_scens, t0)
             self._emit_stats()
@@ -576,8 +581,81 @@ class BatchRound:
             "solve_ledger")
         self._deliver(req, scens, ledger)
 
+    def _start_round_spans(self, breaker_reroute: bool = False) -> None:
+        """Per-request telemetry for this round: a retro ``admission``
+        span covering the queue wait (submit -> round start) plus a live
+        ``batch_round`` span that dispatch-group spans parent under (the
+        rid registration is re-pointed here so ``resolve_group`` on any
+        worker thread finds the right parent without plumbing)."""
+        if not telemetry_trace.enabled():
+            return
+        now_mono = time.monotonic()
+        for req in self.requests:
+            parent = req.span
+            if parent is None:
+                continue
+            wait_s = max(0.0, now_mono - req.t_submit)
+            telemetry_trace.start_span(
+                "admission", parent=parent, t_start=parent.t_start,
+                duration_s=wait_s,
+                attrs={"queue_wait_s": round(wait_s, 6),
+                       "priority": req.priority})
+            rs = telemetry_trace.start_span(
+                "batch_round", parent=parent,
+                attrs={"fidelity": (resilience.FIDELITY_DEGRADED
+                                    if self.degraded
+                                    else resilience.FIDELITY_FULL),
+                       "backend": self.backend_used,
+                       "requests_in_round": len(self.requests)})
+            if self.degraded:
+                # the degraded-fidelity marker must ride the TRACE, not
+                # only the Result — an operator reading a shed request's
+                # timeline sees why it was fast
+                parent.set_attr("fidelity", resilience.FIDELITY_DEGRADED)
+                rs.event("load_shed",
+                         reason="sustained overload — answered by the "
+                                "degraded screening tier")
+            if breaker_reroute:
+                rs.event("breaker_certify_open",
+                         rerouted_backend=self.backend_used)
+            self._round_spans[req.request_id] = rs
+            telemetry_trace.register_request(req.request_id, rs)
+
+    def _end_round_spans(self, led: Dict) -> None:
+        """Close every live ``batch_round`` span with the round's ledger
+        summary attributes and re-point the rid registration back to the
+        request root (delivery-time spans parent under the request, not
+        a finished round)."""
+        if not self._round_spans:
+            return
+        warm = led.get("warm_start") or {}
+        for req in self.requests:
+            rs = self._round_spans.pop(req.request_id, None)
+            if rs is None:
+                continue
+            rs.set_attrs({
+                "backend": self.backend_used,
+                "windows": sum(len(s.windows)
+                               for s in self.scenarios.get(
+                                   req.request_id, {}).values()),
+                "compile_events": int(
+                    (led.get("totals") or {}).get("compile_events", 0)),
+                "warm_seeded": int(warm.get("seeded", 0)),
+                "warm_substituted": int(warm.get("substituted", 0)),
+                "preempted": self.preempted,
+            })
+            rs.end()
+            if req.span is not None:
+                telemetry_trace.register_request(req.request_id, req.span)
+        # requests that left the round early (expiry/duplicate) still
+        # hold a round span — end those too
+        for rid, rs in list(self._round_spans.items()):
+            rs.end()
+        self._round_spans.clear()
+
     def _finish_stats(self, all_scens, t0) -> None:
         led = self.ledger or {}
+        self._end_round_spans(led)
         initial = [g for g in led.get("groups", ())
                    if g.get("rung") in (None, "initial")]
         self.stats = {

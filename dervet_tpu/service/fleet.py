@@ -106,10 +106,17 @@ class ReplicaHandle:
     # -- request path ---------------------------------------------------
     def submit(self, cases, rid: str, *, priority: int = 0,
                deadline_epoch: Optional[float] = None,
-               payload: Optional[bytes] = None) -> None:
+               payload: Optional[bytes] = None,
+               trace_ctx: Optional[Dict] = None) -> None:
         """Hand one request to the replica.  May raise the replica's
         typed admission errors synchronously (local transport); spool
-        transport never raises here — outcomes arrive via :meth:`poll`."""
+        transport never raises here — outcomes arrive via :meth:`poll`.
+
+        ``trace_ctx`` is the router's telemetry trace context
+        (``{"trace_id", "span_id"}``): it rides the transport (spool
+        pickle payload / local submit kwarg) so the replica-side span
+        tree parents under the router's — one stitched trace per
+        request across processes."""
         raise NotImplementedError
 
     def poll(self, rid: str) -> Optional[Tuple[str, object]]:
@@ -141,9 +148,18 @@ class ReplicaHandle:
         """The replica's latest heartbeat record (None = none yet)."""
         raise NotImplementedError
 
-    def probe(self, nonce: str) -> None:
+    def probe(self, nonce: str, trace: Optional[Dict] = None) -> None:
         """Leave a probe nonce for the replica to echo in its next
-        heartbeat — the router's cheap liveness probe (no solve)."""
+        heartbeat — the router's cheap liveness probe (no solve).
+        ``trace`` is an optional telemetry context the replica echoes
+        back alongside the nonce, so probe round-trips are traceable."""
+
+    def published_load(self) -> Optional[Dict]:
+        """The replica's SELF-published load signal (queue depth + drain
+        rate from its telemetry exposition), or None when it has never
+        published — the router's least-loaded ranking prefers this over
+        its own inflight counts, which go stale across failover."""
+        return None
 
     def alive(self) -> Optional[bool]:
         """Process-level liveness when known (None = not owned here)."""
@@ -196,9 +212,13 @@ class SpoolReplica(ReplicaHandle):
     # -- request path ---------------------------------------------------
     @staticmethod
     def encode_payload(cases, *, priority: int = 0,
-                       deadline_epoch: Optional[float] = None) -> bytes:
+                       deadline_epoch: Optional[float] = None,
+                       trace: Optional[Dict] = None) -> bytes:
+        # "trace" is the router's telemetry context: the replica's
+        # submit_pickle hands it to ScenarioService.submit as trace_ctx
         return pickle.dumps({"cases": cases, "priority": int(priority),
-                             "deadline_epoch": deadline_epoch},
+                             "deadline_epoch": deadline_epoch,
+                             **({"trace": trace} if trace else {})},
                             protocol=pickle.HIGHEST_PROTOCOL)
 
     def _fname(self, rid: str) -> str:
@@ -206,10 +226,12 @@ class SpoolReplica(ReplicaHandle):
 
     def submit(self, cases, rid: str, *, priority: int = 0,
                deadline_epoch: Optional[float] = None,
-               payload: Optional[bytes] = None) -> None:
+               payload: Optional[bytes] = None,
+               trace_ctx: Optional[Dict] = None) -> None:
         if payload is None:
             payload = self.encode_payload(cases, priority=priority,
-                                          deadline_epoch=deadline_epoch)
+                                          deadline_epoch=deadline_epoch,
+                                          trace=trace_ctx)
         # dot-prefixed tmp + rename: the serve scan globs non-dot names,
         # so a half-written payload can never be admitted
         final = self.incoming / self._fname(rid)
@@ -271,11 +293,43 @@ class SpoolReplica(ReplicaHandle):
         except (OSError, ValueError):
             return None         # missing or torn mid-replace: no beat
 
-    def probe(self, nonce: str) -> None:
+    def probe(self, nonce: str, trace: Optional[Dict] = None) -> None:
         from ..utils.supervisor import atomic_write
         atomic_write(self.spool / PROBE_FILE,
                      json.dumps({"nonce": str(nonce),
-                                 "t": round(time.time(), 3)}))
+                                 "t": round(time.time(), 3),
+                                 **({"trace": trace} if trace else {})}))
+
+    def published_load(self) -> Optional[Dict]:
+        """Parse the replica's ``telemetry.prom`` exposition (written
+        atomically by its serve loop at the heartbeat cadence) into the
+        routing load signal.  None when the file does not exist (replica
+        never published / telemetry off) or is unreadable."""
+        from ..telemetry import ops as telemetry_ops
+        from ..telemetry import registry as telemetry_registry
+        prom = self.spool / telemetry_ops.PROM_FILE
+        try:
+            text = prom.read_text()
+            parsed = telemetry_registry.parse_prometheus(text)
+            t_published = prom.stat().st_mtime
+        except (OSError, ValueError):
+            return None
+        depth = telemetry_registry.sample_value(
+            parsed, telemetry_ops.M_QUEUE_DEPTH)
+        if depth is None:
+            return None
+        return {
+            "queue_depth": float(depth),
+            "drain_rate_rps": telemetry_registry.sample_value(
+                parsed, telemetry_ops.M_DRAIN_RATE) or 0.0,
+            "pending": telemetry_registry.sample_value(
+                parsed, telemetry_ops.M_PENDING) or 0.0,
+            # wall-clock publish time (exposition mtime): the router
+            # treats a signal older than its staleness bound as
+            # never-published — a frozen file from a dead/restarted
+            # replica must not keep ranking it as idle
+            "t_published": t_published,
+        }
 
     def alive(self) -> Optional[bool]:
         if self.process is None:
@@ -354,7 +408,8 @@ class LocalReplica(ReplicaHandle):
 
     def submit(self, cases, rid: str, *, priority: int = 0,
                deadline_epoch: Optional[float] = None,
-               payload: Optional[bytes] = None) -> None:
+               payload: Optional[bytes] = None,
+               trace_ctx: Optional[Dict] = None) -> None:
         deadline_s = None
         if deadline_epoch is not None:
             deadline_s = max(0.0, deadline_epoch - time.time())
@@ -363,7 +418,7 @@ class LocalReplica(ReplicaHandle):
         # artifact names stay identical to a single-replica run
         self._futures[rid] = self.service.submit(
             cases, request_id=rid, priority=priority,
-            deadline_s=deadline_s)
+            deadline_s=deadline_s, trace_ctx=trace_ctx)
 
     def poll(self, rid: str) -> Optional[Tuple[str, object]]:
         fut = self._futures.get(rid)
@@ -386,6 +441,18 @@ class LocalReplica(ReplicaHandle):
 
     def alive(self) -> Optional[bool]:
         return not self._killed
+
+    def published_load(self) -> Optional[Dict]:
+        """In-process transport: the service's live queue IS the
+        published signal (no exposition file round-trip), gated on the
+        same kill switch so routing behavior matches the spool
+        transport's never-published fallback."""
+        from ..telemetry import registry as telemetry_registry
+        if self._killed or not telemetry_registry.enabled():
+            return None
+        return {"queue_depth": float(self.service.queue.depth()),
+                "drain_rate_rps": self.service.queue.drain_rate() or 0.0,
+                "pending": 0.0}
 
     def kill(self, hard: bool = False) -> None:
         self._killed = True

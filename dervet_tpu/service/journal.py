@@ -53,23 +53,33 @@ class ServiceJournal:
 
     # ------------------------------------------------------------------
     def _append(self, event: str, rid: str, **extra) -> None:
+        # wall + MONOTONIC timestamp pair: replay/`dervet-tpu trace` can
+        # order pre-crash events robustly (mono never steps backwards
+        # within one process incarnation) while the wall time anchors
+        # them against other processes' traces.  Readers tolerate
+        # records without these fields (pre-PR-14 journals).
         rec = {"event": event, "rid": str(rid), "t": round(time.time(), 3),
-               **extra}
+               "mono": round(time.monotonic(), 6),
+               **{k: v for k, v in extra.items() if v is not None}}
         line = json.dumps(rec, sort_keys=True)
         with self._lock:
             self._fh.write(line + "\n")
             self._fh.flush()
             os.fsync(self._fh.fileno())
 
-    def admitted(self, rid: str, file: Optional[str] = None) -> None:
-        self._append("admitted", rid,
+    def admitted(self, rid: str, file: Optional[str] = None,
+                 trace_id: Optional[str] = None) -> None:
+        self._append("admitted", rid, trace_id=trace_id,
                      **({"file": str(file)} if file else {}))
 
-    def completed(self, rid: str) -> None:
-        self._append("completed", rid)
+    def completed(self, rid: str,
+                  trace_id: Optional[str] = None) -> None:
+        self._append("completed", rid, trace_id=trace_id)
 
-    def failed(self, rid: str, error: Optional[Dict] = None) -> None:
-        self._append("failed", rid, **({"error": error} if error else {}))
+    def failed(self, rid: str, error: Optional[Dict] = None,
+               trace_id: Optional[str] = None) -> None:
+        self._append("failed", rid, trace_id=trace_id,
+                     **({"error": error} if error else {}))
 
     def note(self, event: str, rid: str, **extra) -> None:
         """Journal an arbitrary event (fsync'd like the rest).  The
@@ -105,6 +115,9 @@ class ServiceJournal:
             entry["state"] = rec.get("event")
             if rec.get("file"):
                 entry["file"] = rec["file"]
+            if rec.get("trace_id"):
+                # pre-crash timeline reconstruction (telemetry/ops.py)
+                entry["trace_id"] = rec["trace_id"]
         return out
 
     def replay(self) -> Dict[str, Dict]:

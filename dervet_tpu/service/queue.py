@@ -55,7 +55,8 @@ class QueuedRequest:
 
     __slots__ = ("request_id", "cases", "priority", "deadline", "future",
                  "seq", "t_submit", "fingerprint", "kind", "design_case",
-                 "design_spec", "design_state", "portfolio_spec")
+                 "design_spec", "design_state", "portfolio_spec",
+                 "span", "trace_ctx")
 
     def __init__(self, request_id: str, cases: Dict, priority: int = 0,
                  deadline_s: Optional[float] = None, seq: int = 0,
@@ -76,6 +77,11 @@ class QueuedRequest:
         self.design_spec = None
         self.design_state = None
         self.portfolio_spec = None
+        # telemetry (dervet_tpu/telemetry): the request's root span on
+        # THIS process (ends when the future resolves) and the upstream
+        # trace context it was propagated under (fleet transport)
+        self.span = None
+        self.trace_ctx = None
 
     def expired(self) -> bool:
         return self.deadline is not None and time.monotonic() > self.deadline
@@ -121,17 +127,33 @@ class AdmissionQueue:
             with self._cond:
                 self._rounds.append((int(requests_served), float(round_s)))
 
+    def _drain_rate_locked(self) -> Optional[float]:
+        """Requests/sec over the recorded rounds; caller holds the
+        lock.  The ONE drain-rate computation — both the published
+        routing signal and the retry-after hint read it, so they can
+        never diverge."""
+        if not self._rounds:
+            return None
+        served = sum(n for n, _ in self._rounds)
+        busy_s = sum(s for _, s in self._rounds)
+        return served / busy_s if busy_s > 0 else None
+
+    def drain_rate(self) -> Optional[float]:
+        """Observed recent drain rate (requests/sec while solving) —
+        the load signal the replica publishes in ``telemetry.prom`` and
+        the fleet router routes on; None until any round completed."""
+        with self._cond:
+            return self._drain_rate_locked()
+
     def _retry_hint(self) -> float:
         """Seconds a rejected caller should wait: queue depth divided by
         the OBSERVED recent drain rate (requests/sec over the last few
         rounds), so the hint tracks real service speed instead of a
         constant.  Falls back to the static ``retry_after_s`` until any
         round has completed.  Caller holds the lock."""
-        if not self._rounds:
+        rate = self._drain_rate_locked()
+        if rate is None:
             return self.retry_after_s
-        served = sum(n for n, _ in self._rounds)
-        busy_s = sum(s for _, s in self._rounds)
-        rate = served / busy_s          # requests/sec while solving
         # a full queue drains max_depth requests before a retried
         # admission can land; +1 for the retry itself
         hint = (len(self._heap) + 1) / rate

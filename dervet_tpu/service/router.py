@@ -54,6 +54,8 @@ from concurrent.futures import Future
 from pathlib import Path
 from typing import Dict, List, Optional
 
+from ..telemetry import registry as telemetry_registry
+from ..telemetry import trace as telemetry_trace
 from ..utils.breaker import BreakerBoard
 from ..utils.errors import (FleetUnavailableError, QueueFullError,
                             ReplicaAnswerError, ServiceClosedError,
@@ -97,20 +99,29 @@ class RoutedResult:
 
 
 class _Route:
-    __slots__ = ("replica", "t", "kind", "resolved")
+    __slots__ = ("replica", "t", "kind", "resolved", "span")
 
     def __init__(self, replica: str, kind: str):
         self.replica = replica
         self.t = time.monotonic()
         self.kind = kind            # "primary" | "hedge" | "failover"
         self.resolved = False
+        self.span = None            # telemetry transport span
+
+    def end_span(self, outcome: Optional[str] = None,
+                 error=None) -> None:
+        if self.span is not None:
+            if outcome is not None:
+                self.span.set_attr("outcome", outcome)
+            self.span.end(error=error)
+            self.span = None
 
 
 class _Pending:
     __slots__ = ("rid", "fp", "cases", "payload", "priority",
                  "deadline_epoch", "deadline_s", "future", "routes",
                  "t_submit", "answered", "answered_at", "recovered",
-                 "unplaced_since")
+                 "unplaced_since", "span")
 
     def __init__(self, rid, fp, cases, priority, deadline_s):
         self.rid = rid
@@ -128,6 +139,7 @@ class _Pending:
         self.answered_at: Optional[float] = None
         self.recovered = False
         self.unplaced_since: Optional[float] = None
+        self.span = None            # telemetry root span (router side)
 
     def live_routes(self) -> List[_Route]:
         return [r for r in self.routes if not r.resolved]
@@ -215,6 +227,24 @@ class FleetRouter:
             n: None for n in self.replicas}
         self._probes: Dict[str, Dict] = {}
         self._memory_handoffs: Dict[str, int] = {}
+        # replica-PUBLISHED load signals (telemetry.prom scrape): the
+        # least-loaded ranking routes on these — router-side inflight
+        # counts go stale across failover — falling back to inflight
+        # only for a replica that has never published
+        self._pub_load: Dict[str, Optional[Dict]] = {
+            n: None for n in self.replicas}
+        self._scrape_last = 0.0
+        # a published signal whose wall-clock publish time (exposition
+        # mtime) is older than this reads as never-published: a frozen
+        # telemetry.prom from a dead replica — or one respawned with
+        # telemetry off — must not keep ranking it as idle
+        self._pub_stale_s = max(10.0, 3.0 * self.heartbeat_timeout_s)
+        # router-owned metrics registry (separate from the process
+        # default: LocalReplica fleets share the process, and replica
+        # metrics must not blur into the fleet view) — published to
+        # fleet_dir/fleet_telemetry.prom at ~1s cadence
+        self._telemetry = telemetry_registry.MetricsRegistry()
+        self._telemetry_last = 0.0
         self._seq = 0
         self._t_start = time.monotonic()
         self._counters = {
@@ -251,14 +281,23 @@ class FleetRouter:
         with self._lock:
             for p in list(self._pending.values()):
                 if not p.answered and not p.future.done():
-                    p.future.set_exception(ServiceClosedError(
+                    err = ServiceClosedError(
                         f"request {p.rid!r} unanswered at fleet router "
-                        "close — resubmit to a live fleet"))
+                        "close — resubmit to a live fleet")
+                    if p.span is not None:
+                        telemetry_trace.release_request(p.rid)
+                        p.span.end(error=err)
+                        p.span = None
+                    p.future.set_exception(err)
             self._pending.clear()
         if terminate_replicas:
             for h in self.replicas.values():
                 if isinstance(h, SpoolReplica) and h.process is not None:
                     h.terminate()
+        # final exposition (no-op when fleet_dir is unset or the kill
+        # switch is on)
+        self._telemetry_last = 0.0
+        self._publish_fleet_telemetry()
         if self.fleet_dir is not None:
             from ..utils.supervisor import atomic_write
             atomic_write(self.fleet_dir / "fleet_metrics.json",
@@ -303,12 +342,31 @@ class FleetRouter:
                 raise ValueError("a request needs at least one case")
             p = _Pending(rid, structure_fingerprint(cases), cases,
                          priority, deadline_s)
-            self._route(p, kind="primary")   # raises if nowhere to go
+            # telemetry root span: the trace id derives from the rid, so
+            # the replica side (and a post-crash recovery) agrees on it
+            # even if the in-band context is lost
+            span = telemetry_trace.start_span(
+                "fleet_request",
+                trace_id=telemetry_trace.trace_id_for(rid),
+                attrs={"request_id": rid, "priority": int(priority),
+                       "fingerprint": p.fp[:12]})
+            if span:
+                p.span = span
+                telemetry_trace.register_request(rid, span)
+            try:
+                self._route(p, kind="primary")   # raises if nowhere to go
+            except Exception as e:
+                if p.span is not None:
+                    telemetry_trace.release_request(rid)
+                    p.span.event("rejected", error=type(e).__name__)
+                    p.span.end(error=e)
+                raise
             self._pending[rid] = p
             self._counters["submitted"] += 1
         if self.journal is not None:
             self.journal.note("routed", rid,
-                              replica=p.routes[-1].replica)
+                              replica=p.routes[-1].replica,
+                              trace_id=telemetry_trace.trace_id_of(rid))
         return p.future
 
     def _retry_hint(self, name: str) -> float:
@@ -355,24 +413,33 @@ class FleetRouter:
                          < self.max_inflight_per_replica)
         if aff_available:
             ordered.append(aff)
-        # then least-loaded (stable tie-break on name)
+        # then least-loaded, ranked on the replica-PUBLISHED load signal
+        # (queue depth / drain rate from the scraped telemetry
+        # exposition) — router-side inflight counts go stale across
+        # failover; inflight is only the fallback for a replica that has
+        # never published, and the tie-break within a rank
         ordered += sorted(
             (n for n in eligible
              if n not in ordered
              and self._inflight[n] < self.max_inflight_per_replica),
-            key=lambda n: (self._inflight[n], n))
+            key=lambda n: (*self._load_score(n), n))
         hints = []
         for i, name in enumerate(ordered):
             h = self.replicas[name]
             try:
                 h.submit(p.cases, p.rid, priority=p.priority,
                          deadline_epoch=p.deadline_epoch,
-                         payload=self._payload_for(p, h))
+                         payload=self._payload_for(p, h),
+                         trace_ctx=(p.span.ctx()
+                                    if p.span is not None else None))
             except QueueFullError as e:
                 # the replica's own drain-rate hint: keep it, try the
                 # next replica (the router redirect), surface the MIN
                 hints.append(float(e.retry_after_s))
                 self._counters["redirects"] += 1
+                if p.span is not None:
+                    p.span.event("redirect", replica=name,
+                                 retry_after_s=float(e.retry_after_s))
                 continue
             if kind == "primary":
                 if aff_available and name == aff:
@@ -383,7 +450,17 @@ class FleetRouter:
             self._affinity.move_to_end(p.fp)
             while len(self._affinity) > self._affinity_cap:
                 self._affinity.popitem(last=False)
-            p.routes.append(_Route(name, kind))
+            route = _Route(name, kind)
+            if p.span is not None:
+                pub = self._pub_load.get(name)
+                p.span.event("routed", replica=name, kind=kind,
+                             affinity=bool(aff_available and name == aff),
+                             published_load=(None if pub is None else
+                                             pub.get("queue_depth")))
+                route.span = telemetry_trace.start_span(
+                    "transport", parent=p.span,
+                    attrs={"replica": name, "kind": kind})
+            p.routes.append(route)
             p.unplaced_since = None
             self._inflight[name] += 1
             return name
@@ -416,14 +493,48 @@ class FleetRouter:
     def _payload_for(self, p: _Pending, h: ReplicaHandle
                      ) -> Optional[bytes]:
         """Pickle a spool payload once and reuse it for every re-route /
-        hedge of the same request (local transport needs none)."""
+        hedge of the same request (local transport needs none).  The
+        telemetry trace context embedded is the request's ROOT span —
+        stable across re-routes, so the cache stays valid and every
+        replica's span tree parents under the same router span."""
         if not isinstance(h, SpoolReplica):
             return None
         if p.payload is None:
             p.payload = SpoolReplica.encode_payload(
                 p.cases, priority=p.priority,
-                deadline_epoch=p.deadline_epoch)
+                deadline_epoch=p.deadline_epoch,
+                trace=(p.span.ctx() if p.span is not None else None))
         return p.payload
+
+    def _load_score(self, name: str) -> tuple:
+        """Least-loaded rank for one replica: ``(0, est_backlog_s,
+        inflight)`` from its published queue depth + drain rate, or
+        ``(1, inflight, inflight)`` when it has never published or its
+        publication went stale (the inflight fallback).  Lower sorts
+        first; fresh published signals outrank the rest.  Caller holds
+        the lock.
+
+        Router-side inflight is FOLDED INTO the backlog estimate, not
+        only a tie-break: the published depth is a scrape old, so a
+        burst between scrapes would otherwise herd onto whichever
+        replica last published the lowest depth (double-counting a
+        request that has since appeared in the published depth only
+        overweights load uniformly — the ranking stays honest)."""
+        pub = self._pub_load.get(name)
+        inflight = float(self._inflight[name])
+        if pub is not None:
+            t_pub = pub.get("t_published")
+            if (t_pub is not None
+                    and time.time() - float(t_pub) > self._pub_stale_s):
+                pub = None      # frozen exposition — fall back
+        if pub is None:
+            return (1, inflight, inflight)
+        backlog = (float(pub.get("queue_depth") or 0.0)
+                   + float(pub.get("pending") or 0.0)
+                   + inflight)
+        rate = float(pub.get("drain_rate_rps") or 0.0)
+        est_s = backlog / rate if rate > 0 else backlog
+        return (0, est_s, inflight)
 
     # -- the monitor ----------------------------------------------------
     def _monitor_loop(self) -> None:
@@ -439,8 +550,10 @@ class FleetRouter:
 
     def _tick(self) -> None:
         self._poll_answers()
+        self._scrape_published_load()
         self._check_health()
         self._watchdogs()
+        self._publish_fleet_telemetry()
         # answered entries linger only to count late duplicates from
         # hedge/failover losers; prune them after a bounded window so a
         # loser that never answers cannot pin memory
@@ -450,6 +563,68 @@ class FleetRouter:
                         if p.answered and p.answered_at is not None
                         and now - p.answered_at > 60.0]:
                 self._pending.pop(rid, None)
+
+    def _scrape_published_load(self) -> None:
+        """Refresh the replica-published load signals (bounded cadence —
+        each scrape is a file read + exposition parse per replica).  A
+        replica whose exposition vanishes or goes unreadable keeps its
+        last signal; one that never published stays None (the inflight
+        fallback)."""
+        now = time.monotonic()
+        if now - self._scrape_last < 0.25:
+            return
+        self._scrape_last = now
+        for name, h in self.replicas.items():
+            if h.state == "dead":
+                continue
+            try:
+                pub = h.published_load()
+            except Exception:
+                pub = None
+            if pub is not None:
+                pub["t_scraped"] = now
+                with self._lock:
+                    self._pub_load[name] = pub
+
+    def _publish_fleet_telemetry(self) -> None:
+        """Write the router's own exposition (``fleet_telemetry.prom``)
+        next to the fleet journal at ~1s cadence: replica liveness /
+        inflight / scraped load as gauges, the routing counters, and the
+        fleet request-latency histogram (same fixed bucket layout as the
+        replicas', so `status` merges them exactly)."""
+        if self.fleet_dir is None or not telemetry_registry.enabled():
+            return
+        now = time.monotonic()
+        if now - self._telemetry_last < 1.0:
+            return
+        self._telemetry_last = now
+        reg = self._telemetry
+        with self._lock:
+            counters = dict(self._counters)
+            inflight = dict(self._inflight)
+            pub_load = dict(self._pub_load)
+        for k, v in counters.items():
+            reg.gauge(f"dervet_fleet_{k}").set(float(v))
+        for name, h in self.replicas.items():
+            reg.gauge("dervet_fleet_replica_up", replica=name).set(
+                0.0 if h.state == "dead" else 1.0)
+            reg.gauge("dervet_fleet_inflight", replica=name).set(
+                float(inflight.get(name, 0)))
+            pub = pub_load.get(name)
+            if pub is not None:
+                reg.gauge("dervet_fleet_published_queue_depth",
+                          replica=name).set(
+                    float(pub.get("queue_depth") or 0.0))
+                reg.gauge("dervet_fleet_published_drain_rate_rps",
+                          replica=name).set(
+                    float(pub.get("drain_rate_rps") or 0.0))
+        reg.sample()
+        try:
+            from ..telemetry.ops import FLEET_PROM_FILE
+            reg.write_prom(self.fleet_dir / FLEET_PROM_FILE)
+        except OSError as e:
+            TellUser.warning(f"fleet: telemetry exposition write "
+                             f"failed: {e}")
 
     def _poll_answers(self) -> None:
         with self._lock:
@@ -472,6 +647,8 @@ class FleetRouter:
             if route.resolved:
                 return
             route.resolved = True
+            route.end_span(outcome=kind, error=(
+                None if kind == "done" else "replica reported failure"))
             self._inflight[route.replica] = max(
                 0, self._inflight[route.replica] - 1)
             first = not p.answered
@@ -482,16 +659,22 @@ class FleetRouter:
             else:
                 self._counters["duplicates_suppressed"] += 1
             self._gc_pending(p)
-            if not first:
-                return
-            latency = time.monotonic() - p.t_submit
-            self._latencies.append(latency)
-            self._completions[route.replica].append(time.monotonic())
-            if route.kind == "hedge":
-                self._counters["hedge_wins"] += 1
-            if route.kind == "failover" or harvested:
-                self._failover_latencies.append(latency)
-            losers = p.live_routes()
+            if first:
+                latency = time.monotonic() - p.t_submit
+                self._latencies.append(latency)
+                self._completions[route.replica].append(time.monotonic())
+                if route.kind == "hedge":
+                    self._counters["hedge_wins"] += 1
+                if route.kind == "failover" or harvested:
+                    self._failover_latencies.append(latency)
+                losers = p.live_routes()
+        if not first:
+            # the loser's just-ended transport span re-entered the
+            # collector under an already-exported trace id — merge it
+            # into the export so its timing survives and the orphan
+            # collector slot is freed
+            self._export_late_trace(p.rid)
+            return
         # answering at all is evidence the replica works — typed request
         # failures (bad inputs) are the request's fault, not the path's
         self.breakers.record(route.replica, True)
@@ -515,7 +698,9 @@ class FleetRouter:
             with self._lock:
                 self._counters["completed"] += 1
             if self.journal is not None:
-                self.journal.completed(p.rid)
+                self.journal.completed(
+                    p.rid, trace_id=telemetry_trace.trace_id_of(p.rid))
+            self._finish_trace(p, route, "done", harvested, latency)
             p.future.set_result(res)
         else:
             err = (answer if isinstance(answer, BaseException)
@@ -528,8 +713,58 @@ class FleetRouter:
                 self._counters["failed"] += 1
             if self.journal is not None:
                 self.journal.failed(p.rid, getattr(err, "payload", None)
-                                    or {"message": str(err)})
+                                    or {"message": str(err)},
+                                    trace_id=telemetry_trace
+                                    .trace_id_of(p.rid))
+            self._finish_trace(p, route, "failed", harvested, latency,
+                               error=err)
             p.future.set_exception(err)
+
+    def _finish_trace(self, p: _Pending, route: _Route, outcome: str,
+                      harvested: bool, latency: float,
+                      error=None) -> None:
+        """First-delivery telemetry tail: close the request's router-
+        side root span, export the router's slice of the trace
+        (``fleet_dir/traces/trace.<rid>.json`` + Chrome timeline — the
+        ``trace`` CLI stitches it with the replicas' exports), and feed
+        the fleet latency histogram."""
+        if telemetry_registry.enabled():
+            self._telemetry.histogram(
+                "dervet_fleet_request_latency_seconds").observe(latency)
+        if p.span is None:
+            return
+        telemetry_trace.release_request(p.rid)
+        p.span.set_attrs({"replica": route.replica, "outcome": outcome,
+                          "harvested": harvested,
+                          "hedged": route.kind == "hedge",
+                          "recovered": (route.kind == "failover"
+                                        or harvested),
+                          "latency_s": round(latency, 6)})
+        p.span.end(error=error)
+        if self.fleet_dir is None or not telemetry_trace.enabled():
+            return
+        try:
+            telemetry_trace.export_request_trace(
+                p.rid, self.fleet_dir / "traces", chrome=True)
+        except Exception as e:      # observability must never block
+            TellUser.warning(f"fleet: trace export for {p.rid} "
+                             f"failed: {e}")
+
+    def _export_late_trace(self, rid) -> None:
+        """Late-answer telemetry tail: a hedge/failover loser answered
+        after the request's trace was exported.  Merge its span into
+        the on-disk export (popping the orphan collector entry).  With
+        no fleet_dir the first delivery never popped either — the
+        loser's span joined the live collector entry and there is
+        nothing to do."""
+        if self.fleet_dir is None or not telemetry_trace.enabled():
+            return
+        try:
+            telemetry_trace.export_request_trace(
+                rid, self.fleet_dir / "traces", chrome=True, merge=True)
+        except Exception as e:      # observability must never block
+            TellUser.warning(f"fleet: late trace export for {rid} "
+                             f"failed: {e}")
 
     def _retire(self, rid: str, replica: str) -> None:
         """Caller holds the lock."""
@@ -619,6 +854,15 @@ class FleetRouter:
                 self._probes.pop(name, None)
                 with self._lock:
                     self._counters["probes_ok"] += 1
+                span = pr.get("span")
+                if span is not None:
+                    # the heartbeat carried the probe's trace context
+                    # back (fleet.py writes it, the serve loop echoes
+                    # it): the probe round-trip closes as one span
+                    span.event("echo", pid=hb.get("pid"),
+                               echoed_trace=bool(hb.get("probe_trace")))
+                    span.end()
+                    self._drain_probe_trace(name)
                 # counter first: record(True) closes the breaker, which
                 # is what callers wait on — the count must already be
                 # there when they look
@@ -626,18 +870,53 @@ class FleetRouter:
                 return
             if time.monotonic() - pr["t"] > self.probe_timeout_s:
                 self._probes.pop(name, None)
+                span = pr.get("span")
+                if span is not None:
+                    span.end(error="probe timeout — no echo within "
+                                   f"{self.probe_timeout_s:g}s")
+                    self._drain_probe_trace(name)
                 br.record(False)
             return
         if br.state != br.CLOSED and br.allow():
             nonce = f"{name}-{time.time_ns()}"
+            # probe spans live on a per-replica probe trace (rid
+            # ``probe.<name>``), exported to ``fleet/traces`` at each
+            # round-trip (`dervet-tpu trace probe.<name> FLEET_DIR`)
+            span = telemetry_trace.start_span(
+                "probe",
+                trace_id=telemetry_trace.trace_id_for(f"probe.{name}"),
+                attrs={"replica": name, "nonce": nonce})
             try:
-                self.replicas[name].probe(nonce)
+                self.replicas[name].probe(
+                    nonce, trace=(span.ctx() if span else None))
             except Exception:
+                if span:
+                    span.end(error="probe write failed")
+                    self._drain_probe_trace(name)
                 br.record(False)
                 return
-            self._probes[name] = {"nonce": nonce, "t": time.monotonic()}
+            self._probes[name] = {"nonce": nonce, "t": time.monotonic(),
+                                  "span": (span if span else None)}
             with self._lock:
                 self._counters["probes_sent"] += 1
+
+    def _drain_probe_trace(self, name: str) -> None:
+        """Export (or discard) the per-replica ``probe.<name>`` trace
+        after each probe round-trip: probe traces are never delivered
+        through the request path, so without this a long-lived router
+        pins every probe span in the collector until the per-trace cap
+        silently drops new ones."""
+        prid = f"probe.{name}"
+        exported = None
+        if self.fleet_dir is not None:
+            try:
+                exported = telemetry_trace.export_request_trace(
+                    prid, self.fleet_dir / "traces")
+            except Exception:       # observability must never block
+                exported = None
+        if exported is None:
+            telemetry_trace.COLLECTOR.pop(
+                telemetry_trace.trace_id_for(prid))
 
     def _declare_dead(self, name: str, reason: str) -> None:
         h = self.replicas[name]
@@ -662,6 +941,12 @@ class FleetRouter:
         blob = h.read_memory_export()
         handed_off: set = set()
         for p, route in victims:
+            if p.span is not None:
+                # the failover-drill trace contract: fence, then either
+                # harvest or re-route, visible on the stitched timeline
+                p.span.event("fence", replica=name,
+                             reason="replica declared dead — SIGKILL "
+                                    "fenced before recovery")
             state = h.request_state(p.rid)
             if state in ("completed", "failed"):
                 # the replica finished this one before dying: harvest —
@@ -683,14 +968,20 @@ class FleetRouter:
                         with self._lock:
                             self._counters["harvested"] += 1
                         if self.journal is not None:
-                            self.journal.note("harvested", p.rid,
-                                              replica=name)
+                            self.journal.note(
+                                "harvested", p.rid, replica=name,
+                                trace_id=telemetry_trace
+                                .trace_id_of(p.rid))
+                        if p.span is not None:
+                            p.span.event("harvest", replica=name)
                     self._deliver(p, route, outcome, harvested=True)
                     continue
             # unanswered: fence its spool entry, then re-route with the
             # dead replica's warm-start memory riding along
             with self._lock:
                 route.resolved = True
+                route.end_span(outcome="dead",
+                               error="replica died before answering")
                 self._inflight[name] = max(0, self._inflight[name] - 1)
                 if p.answered:
                     self._gc_pending(p)
@@ -723,8 +1014,13 @@ class FleetRouter:
             target = self._route(p, kind="failover", exclude=exclude)
             if target is not None:
                 self._counters[counter] += 1
-        if target is not None and self.journal is not None:
-            self.journal.note("rerouted", p.rid, to=target)
+        if target is not None:
+            if self.journal is not None:
+                self.journal.note("rerouted", p.rid, to=target,
+                                  trace_id=telemetry_trace
+                                  .trace_id_of(p.rid))
+            if p.span is not None:
+                p.span.event("reroute", to=target, kind=counter)
         return target
 
     # -- watchdog + hedging ---------------------------------------------
@@ -747,12 +1043,18 @@ class FleetRouter:
                     > self.placement_patience_s)
                 if expired or patience_over:
                     if not p.future.done():
-                        p.future.set_exception(FleetUnavailableError(
+                        err = FleetUnavailableError(
                             f"request {p.rid!r} could not be re-placed "
                             "on any healthy replica"
                             + (" before its deadline" if expired else
                                f" within {self.placement_patience_s:g}s"),
-                            retry_after_s=1.0))
+                            retry_after_s=1.0)
+                        if p.span is not None:
+                            telemetry_trace.release_request(p.rid)
+                            p.span.event("unplaceable",
+                                         expired=bool(expired))
+                            p.span.end(error=err)
+                        p.future.set_exception(err)
                     with self._lock:
                         self._counters["failed"] += 1
                         self._retire(p.rid, "")
@@ -789,8 +1091,14 @@ class FleetRouter:
                                              exclude=exclude)
                         if target is not None:
                             self._counters["hedged"] += 1
-                    if target is not None and self.journal is not None:
-                        self.journal.note("hedged", p.rid, to=target)
+                    if target is not None:
+                        if self.journal is not None:
+                            self.journal.note(
+                                "hedged", p.rid, to=target,
+                                trace_id=telemetry_trace
+                                .trace_id_of(p.rid))
+                        if p.span is not None:
+                            p.span.event("hedged", to=target)
 
     # -- observability --------------------------------------------------
     def metrics(self) -> Dict:
@@ -800,6 +1108,7 @@ class FleetRouter:
             fol = np.asarray(self._failover_latencies, dtype=float)
             counters = dict(self._counters)
             inflight = dict(self._inflight)
+            pub_load = dict(self._pub_load)
             pending = len(self._pending)
         aff_total = counters["affinity_hits"] + counters["affinity_misses"]
         replicas = {}
@@ -815,6 +1124,10 @@ class FleetRouter:
                 "heartbeat": hb,
                 "memory_handoffs_received":
                     self._memory_handoffs.get(name, 0),
+                # the scraped self-published load signal this replica is
+                # currently ranked by (None = never published: the
+                # router falls back to its inflight count)
+                "published_load": pub_load.get(name),
             }
         pct = (lambda a, q: round(float(np.percentile(a, q)), 4)
                if a.size else None)

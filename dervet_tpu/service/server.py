@@ -42,6 +42,9 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..scenario.scenario import SolverCache
+from ..telemetry import ops as telemetry_ops
+from ..telemetry import registry as telemetry_registry
+from ..telemetry import trace as telemetry_trace
 from ..utils.breaker import BreakerBoard
 from ..utils.errors import (BreakerOpenError, PoisonRequestError,
                             PreemptedError, TellUser)
@@ -199,10 +202,16 @@ class ScenarioService:
 
     # -- admission ------------------------------------------------------
     def submit(self, cases, *, request_id=None, priority: int = 0,
-               deadline_s: Optional[float] = None) -> Future:
+               deadline_s: Optional[float] = None,
+               trace_ctx: Optional[Dict] = None) -> Future:
         """Admit one request (a dict of case key -> ``CaseParams``, or an
         iterable of cases) and return the future its
         :class:`~dervet_tpu.results.result.Result` is delivered through.
+
+        ``trace_ctx`` is an upstream telemetry trace context (the fleet
+        router's ``{"trace_id", "span_id"}`` riding the transport
+        payload) — the request's span tree parents under it, so one
+        stitched trace follows the request across processes.
 
         Raises :class:`~dervet_tpu.service.queue.QueueFullError` (with a
         ``retry_after_s`` hint) under backpressure and
@@ -217,11 +226,12 @@ class ScenarioService:
             raise ValueError("a request needs at least one case")
         fingerprint = resilience.request_fingerprint(cases)
         return self._admit(request_id, fingerprint, priority, deadline_s,
-                           cases=cases)
+                           cases=cases, trace_ctx=trace_ctx)
 
     def submit_design(self, case, spec=None, *, request_id=None,
                       priority: int = 0,
                       deadline_s: Optional[float] = None,
+                      trace_ctx: Optional[Dict] = None,
                       **spec_kwargs) -> Future:
         """Admit one DESIGN request (BOOST sizing): screen a candidate
         population over ``spec``'s bounds, certify the top-k, deliver a
@@ -243,11 +253,12 @@ class ScenarioService:
         fingerprint = design_fingerprint(case, spec)
         return self._admit(request_id, fingerprint, priority, deadline_s,
                            kind="design", design_case=case,
-                           design_spec=spec)
+                           design_spec=spec, trace_ctx=trace_ctx)
 
     def submit_portfolio(self, spec, *, request_id=None,
                          priority: int = 0,
-                         deadline_s: Optional[float] = None) -> Future:
+                         deadline_s: Optional[float] = None,
+                         trace_ctx: Optional[Dict] = None) -> Future:
         """Admit one PORTFOLIO request (coupled-fleet co-optimization):
         solve ``spec``'s member sites as one LP under the shared
         coupling constraints via the dual-decomposed outer loop
@@ -265,11 +276,13 @@ class ScenarioService:
         spec.validate()       # spec errors raise HERE, at admission
         fingerprint = portfolio_fingerprint(spec)
         return self._admit(request_id, fingerprint, priority, deadline_s,
-                           kind="portfolio", portfolio_spec=spec)
+                           kind="portfolio", portfolio_spec=spec,
+                           trace_ctx=trace_ctx)
 
     def _admit(self, request_id, fingerprint, priority, deadline_s, *,
                cases=None, kind: str = "scenario", design_case=None,
-               design_spec=None, portfolio_spec=None) -> Future:
+               design_spec=None, portfolio_spec=None,
+               trace_ctx: Optional[Dict] = None) -> Future:
         """Shared admission tail: backend breaker, poison blocklist,
         id allocation/validation, queue put with typed rejection."""
         if self.breakers.is_open("backend"):
@@ -313,14 +326,47 @@ class ScenarioService:
         req.design_case = design_case
         req.design_spec = design_spec
         req.portfolio_spec = portfolio_spec
+        # telemetry: the request's root span on this process — a child
+        # of the upstream (router) context when one rode the transport,
+        # else a fresh root whose trace id derives from the request id
+        # (so cross-process stitching never depends on in-band context)
+        req.trace_ctx = trace_ctx
+        span = telemetry_trace.start_span(
+            "request", parent=trace_ctx, rid=str(request_id),
+            attrs={"request_id": str(request_id), "kind": kind,
+                   "priority": int(priority)})
+        if span:
+            req.span = span
+            telemetry_trace.register_request(str(request_id), span)
+        # capture rid + span only — a closure over the QueuedRequest
+        # would pin the full case payload for the future's lifetime
+        # (futures keep their callback list after resolution)
         req.future.add_done_callback(
-            lambda _f, rid=str(request_id): self._release_id(rid))
+            lambda f, rid=str(request_id), s=span or None:
+            self._request_done(rid, s, f))
         try:
             self.queue.put(req)
-        except ServiceError:
+        except ServiceError as e:
             self._release_id(str(request_id))
+            if span:
+                telemetry_trace.release_request(str(request_id))
+                span.event("admission_rejected",
+                           error=type(e).__name__).end(error=e)
             raise
         return req.future
+
+    def _request_done(self, rid: str, span, fut) -> None:
+        """Future-resolution callback (added FIRST, at admission, so it
+        runs before the serve loop's trace export): free the id and end
+        the request's telemetry span with the delivery outcome."""
+        self._release_id(rid)
+        if span is not None:
+            telemetry_trace.release_request(rid)
+            try:
+                err = fut.exception()
+            except Exception:
+                err = None
+            span.end(error=err)
 
     def _release_id(self, rid: str) -> None:
         with self._seq_lock:
@@ -351,6 +397,9 @@ class ScenarioService:
         if deadline_epoch is not None:
             kwargs.setdefault("deadline_s",
                               max(0.0, float(deadline_epoch) - time.time()))
+        # trace context rides the transport payload: the replica-side
+        # span tree parents under the router's transport span
+        kwargs.setdefault("trace_ctx", payload.get("trace"))
         return self.submit(payload["cases"], **kwargs)
 
     def submit_design_file(self, path, base_path=None, **kwargs) -> Future:
@@ -601,8 +650,10 @@ class ScenarioService:
                     self._requests["completed"] += 1
                     self._latencies.append(
                         time.monotonic() - req.t_submit)
+                    self._note_request_telemetry(req, True)
                 elif fut.done():
                     self._requests["failed"] += 1
+                    self._note_request_telemetry(req, False)
         if dr.last_screen is not None:
             self.last_screen_stats = dr.last_screen
 
@@ -624,8 +675,10 @@ class ScenarioService:
                     self._requests["completed"] += 1
                     self._latencies.append(
                         time.monotonic() - req.t_submit)
+                    self._note_request_telemetry(req, True)
                 elif fut.done():
                     self._requests["failed"] += 1
+                    self._note_request_telemetry(req, False)
         if pr.last_portfolio is not None:
             self.last_portfolio = pr.last_portfolio
 
@@ -658,6 +711,11 @@ class ScenarioService:
                         mo if prev is None else min(prev, mo))
         if rnd.ledger is not None:
             self.last_round_ledger = rnd.ledger
+        self._telemetry_round(
+            st, rnd.ledger,
+            {(rid, key): getattr(s, "certification", None)
+             for rid, scens in rnd.scenarios.items()
+             for key, s in scens.items()})
         if st.get("round_s"):
             # the backpressure retry-after hint derives from the
             # OBSERVED drain rate: feed the queue this round's sample
@@ -675,6 +733,62 @@ class ScenarioService:
                 f"(bound {self.max_cached_structures}) — clearing")
             self.solver_cache.clear()
 
+    def _telemetry_round(self, st: Dict, ledger: Optional[Dict],
+                         cert_by_case: Optional[Dict] = None) -> None:
+        """Feed the round's observables into the process metrics
+        registry (dervet_tpu/telemetry) — the numbers already exist in
+        the stats/ledger; this just makes them survive as time series
+        and cross-replica-mergeable histograms.  No-op under the
+        telemetry kill switch."""
+        if not telemetry_registry.enabled():
+            return
+        reg = telemetry_registry.get_registry()
+        reg.counter("dervet_rounds_total").inc()
+        reg.counter(telemetry_ops.M_WINDOWS).inc(
+            int(st.get("windows", 0)))
+        reg.counter("dervet_compile_events_total").inc(
+            int(st.get("compile_events", 0)))
+        el = st.get("elastic")
+        if el:
+            reg.counter(telemetry_ops.M_STEALS).inc(
+                int(el.get("steals", 0)))
+        warm = (ledger or {}).get("warm_start")
+        if warm:
+            for grade in ("exact", "near", "predicted", "dual_iterate",
+                          "cold"):
+                n = int(warm.get(grade, 0))
+                if n:
+                    reg.counter(telemetry_ops.M_WARM,
+                                grade=grade).inc(n)
+        accepted = rejected = 0
+        for cert in (cert_by_case or {}).values():
+            if not cert or not cert.get("enabled"):
+                continue
+            accepted += (int(cert.get("certified", 0))
+                         + int(cert.get("certified_loose", 0)))
+            rejected += int(cert.get("rejected", 0))
+        if accepted:
+            reg.counter(telemetry_ops.M_CERT, verdict="accepted").inc(
+                accepted)
+        if rejected:
+            reg.counter(telemetry_ops.M_CERT, verdict="rejected").inc(
+                rejected)
+        reg.gauge(telemetry_ops.M_QUEUE_DEPTH).set(self.queue.depth())
+        reg.gauge(telemetry_ops.M_DRAIN_RATE).set(
+            self.queue.drain_rate() or 0.0)
+
+    def _note_request_telemetry(self, req, ok: bool) -> None:
+        """Per-delivery registry counters (caller may hold the metrics
+        lock; the registry has its own)."""
+        if not telemetry_registry.enabled():
+            return
+        reg = telemetry_registry.get_registry()
+        reg.counter(telemetry_ops.M_REQUESTS,
+                    outcome=("completed" if ok else "failed")).inc()
+        if ok:
+            reg.histogram(telemetry_ops.M_REQ_LATENCY).observe(
+                time.monotonic() - req.t_submit)
+
     def _absorb_request_outcomes(self, rnd: BatchRound) -> None:
         """Per-request accounting after delivery — including requests
         answered during batch assembly (expiry, duplicate id, assembly
@@ -687,8 +801,10 @@ class ScenarioService:
                     self._requests["completed"] += 1
                     self._latencies.append(
                         time.monotonic() - req.t_submit)
+                    self._note_request_telemetry(req, True)
                 elif fut.done():
                     self._requests["failed"] += 1
+                    self._note_request_telemetry(req, False)
 
     # -- shutdown -------------------------------------------------------
     def _fail_pending(self) -> None:
@@ -890,6 +1006,10 @@ def serve_main(argv=None) -> int:
                         help="publish the warm-start memory export at "
                              "this cadence when it changed (failover "
                              "handoff; 0 disables)")
+    parser.add_argument("--telemetry-port", type=int, default=0,
+                        help="also serve the Prometheus exposition on "
+                             "localhost:<port>/metrics (0 = file "
+                             "exposition only)")
     args = parser.parse_args(argv)
 
     from . import fleet as fleet_mod
@@ -920,6 +1040,11 @@ def serve_main(argv=None) -> int:
         max_batch_requests=args.max_batch_requests,
         checkpoint_dir=args.checkpoint_dir or spool / "checkpoints")
     service.start()
+    if args.telemetry_port and telemetry_registry.enabled():
+        port = telemetry_registry.get_registry().serve_http(
+            args.telemetry_port)
+        TellUser.info(f"serve: telemetry exposition on "
+                      f"http://127.0.0.1:{port}/metrics")
     pending: Dict[str, Future] = {}
 
     # -- fleet-replica machinery (no-ops for a solo serve loop) ---------
@@ -936,10 +1061,15 @@ def serve_main(argv=None) -> int:
         a wedged scan loop (or a dead process) is exactly what stops it.
         Echoes the router's probe nonce (breaker half-open probes cost a
         file read, not a solve)."""
-        nonce = None
+        nonce = probe_trace = None
         try:
-            nonce = json.loads(
-                (spool / fleet_mod.PROBE_FILE).read_text()).get("nonce")
+            probe_doc = json.loads(
+                (spool / fleet_mod.PROBE_FILE).read_text())
+            nonce = probe_doc.get("nonce")
+            # echo the router's probe telemetry context verbatim: the
+            # probe span's round-trip closes on the router side when
+            # this heartbeat lands (trace context rides the echo)
+            probe_trace = probe_doc.get("trace")
         except (OSError, ValueError):
             pass
         mem = service.solver_cache.memory
@@ -959,7 +1089,27 @@ def serve_main(argv=None) -> int:
             "memory_entries": (len(mem._entries)
                                if mem is not None else 0),
             "probe_nonce": nonce,
+            **({"probe_trace": probe_trace} if probe_trace else {}),
         }))
+
+    def write_telemetry() -> None:
+        """Publish the metrics-registry exposition next to the heartbeat
+        (``telemetry.prom``, atomic) — the load signal the fleet router
+        scrapes so routing follows PUBLISHED queue depth + drain rate
+        instead of router-side inflight guesses.  Gated on the kill
+        switch: with telemetry off, no file is ever written."""
+        if not telemetry_registry.enabled():
+            return
+        reg = telemetry_registry.get_registry()
+        reg.gauge(telemetry_ops.M_QUEUE_DEPTH).set(service.queue.depth())
+        reg.gauge(telemetry_ops.M_DRAIN_RATE).set(
+            service.queue.drain_rate() or 0.0)
+        reg.gauge(telemetry_ops.M_PENDING).set(len(pending))
+        for bname, snap in service.breakers.snapshot().items():
+            reg.gauge(telemetry_ops.M_BREAKER_OPEN, breaker=bname).set(
+                1.0 if snap.get("state") == "open" else 0.0)
+        reg.sample()            # ring-buffer time-series tick
+        reg.write_prom(spool / telemetry_ops.PROM_FILE)
 
     def sync_memory() -> None:
         """Warm-start memory handoff, both directions: install exports
@@ -1004,6 +1154,7 @@ def serve_main(argv=None) -> int:
                 now - hb_state["last"] >= args.heartbeat_s:
             hb_state["last"] = now
             write_heartbeat()
+            write_telemetry()
         sync_memory()
 
     def _error_payload(err: BaseException) -> dict:
@@ -1014,6 +1165,20 @@ def serve_main(argv=None) -> int:
             return err.as_dict()
         return {"error": type(err).__name__, "kind": "error",
                 "message": str(err), "retry_hint": None}
+
+    def _export_traces(rid: str) -> None:
+        """Per-request trace export into the spool results dir: the span
+        tree as ``trace.<rid>.json`` plus the Chrome trace-event
+        timeline.  Gated on the kill switch — with telemetry off this
+        writes NOTHING (the zero-telemetry-files contract)."""
+        if not telemetry_trace.enabled():
+            return
+        try:
+            telemetry_trace.export_request_trace(
+                rid, results_root / rid, chrome=True)
+        except Exception as e:      # observability must never block
+            TellUser.warning(f"serve: trace export for {rid} failed: "
+                             f"{e}")
 
     def _finish(path: Path, rid: str, fut: Future) -> None:
         """Done-callback: persist the request's outputs (or its error),
@@ -1033,7 +1198,10 @@ def serve_main(argv=None) -> int:
                                      "fidelity": res.fidelity,
                                      "resubmit_hint": res.resubmit_hint,
                                  }, indent=2))
-                journal.completed(rid)
+                _export_traces(rid)
+                journal.completed(rid, trace_id=telemetry_trace
+                                  .trace_id_for(rid)
+                                  if telemetry_trace.enabled() else None)
                 path.replace(done_dir / path.name)
                 TellUser.info(f"serve: request {rid} done -> "
                               f"{results_root / rid}")
@@ -1043,7 +1211,10 @@ def serve_main(argv=None) -> int:
                              f"{type(err).__name__}: {err}\n")
                 atomic_write(failed_dir / f"{path.name}.error.json",
                              json.dumps(payload, indent=2))
-                journal.failed(rid, payload)
+                _export_traces(rid)
+                journal.failed(rid, payload,
+                               trace_id=telemetry_trace.trace_id_for(rid)
+                               if telemetry_trace.enabled() else None)
                 path.replace(failed_dir / path.name)
                 TellUser.error(f"serve: request {rid} failed: {err}")
         except Exception as e:          # never kill the batcher thread
@@ -1088,7 +1259,9 @@ def serve_main(argv=None) -> int:
                         # the router (same trust domain)
                         fut = service.submit_pickle(path, request_id=rid)
                         pending[rid] = fut
-                        journal.admitted(rid, path.name)
+                        journal.admitted(
+                            rid, path.name,
+                            trace_id=telemetry_trace.trace_id_of(rid))
                         admissions += 1
                         fut.add_done_callback(
                             lambda f, p=path, r=rid: _finish(p, r, f))
@@ -1142,7 +1315,8 @@ def serve_main(argv=None) -> int:
                                    f"{e}")
                     continue
                 pending[rid] = fut
-                journal.admitted(rid, path.name)
+                journal.admitted(rid, path.name,
+                                 trace_id=telemetry_trace.trace_id_of(rid))
                 admissions += 1
                 fut.add_done_callback(
                     lambda f, p=path, r=rid: _finish(p, r, f))
@@ -1167,6 +1341,7 @@ def serve_main(argv=None) -> int:
         service.drain()
         if args.heartbeat_s:
             write_heartbeat()   # final beat advertises draining=True
+        write_telemetry()       # final exposition (no-op when disabled)
     journal.close()
     metrics = service.metrics()
     atomic_write(spool / "service_metrics.json",

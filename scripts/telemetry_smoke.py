@@ -1,0 +1,344 @@
+"""CI smoke: the telemetry plane end to end on a real 3-replica fleet.
+
+Boots a 3-replica fleet (real ``dervet-tpu serve`` subprocesses over
+file spools, CPU backend) and serves a MIXED workload — scenario
+requests through :class:`~dervet_tpu.service.router.FleetRouter`, plus
+a BOOST design request and a coupled-portfolio request dropped straight
+into replica spools.  The telemetry contract under check:
+
+* **every request traces** — each request (all three kinds) produced a
+  ``trace.<rid>.json`` export whose stitched span set passes
+  :func:`~dervet_tpu.telemetry.trace.validate_trace` (single root,
+  unique ids, one trace id, no negative durations), and the routed
+  scenario traces cover the full hop chain (fleet_request -> transport
+  -> batch_round -> dispatch_group);
+* **exposition parses** — every replica published a ``telemetry.prom``
+  that :func:`~dervet_tpu.telemetry.registry.parse_prometheus` accepts,
+  and the fleet-status histogram MERGE is consistent: merged count ==
+  sum of per-replica counts, and the merged request-latency p50 agrees
+  with the stitched traces' ``request``-span p50 within the log-bucket
+  resolution (the two surfaces measure the same path independently);
+* **ops CLIs work** — ``dervet-tpu status`` and ``dervet-tpu trace``
+  exit 0 against the live fleet dir, and the Chrome trace-event export
+  loads as JSON;
+* **kill switch is real** — ``DERVET_TPU_TELEMETRY=0`` reproduces the
+  full result-CSV surface BYTE-IDENTICALLY with ZERO telemetry files
+  written (no trace exports, no ``telemetry.prom``).
+
+Env knobs: SMOKE_TELEM_REQUESTS (default 4 scenario requests),
+SMOKE_TELEM_DEADLINE_S (default 300).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_REQ = int(os.environ.get("SMOKE_TELEM_REQUESTS", "4"))
+DEADLINE_S = float(os.environ.get("SMOKE_TELEM_DEADLINE_S", "300"))
+
+
+def log(msg: str) -> None:
+    print(f"telemetry-smoke: {msg}", file=sys.stderr, flush=True)
+
+
+def workload():
+    """N scenario requests, one case each: DISTINCT window lengths
+    (distinct LP structures) and distinct ratings (distinct content) so
+    cross-request warm seeding cannot blur the byte-identity gate."""
+    from dervet_tpu.benchlib import synthetic_sensitivity_cases
+    out = {}
+    for i in range(N_REQ):
+        case = synthetic_sensitivity_cases(1, n=72 + 24 * i, months=1)[0]
+        for tag, _, keys in case.ders:
+            if tag == "Battery":
+                keys["ene_max_rated"] = 8000.0 + 10.0 * i
+        out[f"sc{i:02d}"] = {0: case}
+    return out
+
+
+def write_design_request(out_dir: Path) -> Path:
+    """A spool-shaped BOOST design request: a reference-format
+    model-parameters CSV + its time series + the design.json that
+    references them (same fixture shape the design-service tests
+    serve)."""
+    import pandas as pd
+
+    from dervet_tpu.benchlib import synthetic_case
+    case = synthetic_case(seed=0)
+    ts = case.datasets.time_series.iloc[:72]
+    ts_path = out_dir / "ts.csv"
+    # the loader expects hour-ENDING stamps (it shifts back by dt)
+    ts.set_axis(ts.index + pd.Timedelta(hours=1)).rename_axis(
+        "Datetime (he)").to_csv(ts_path)
+    rows = [
+        ("Scenario", "", "dt", "1", "float"),
+        ("Scenario", "", "opt_years", "[2017]", "list/int"),
+        ("Scenario", "", "n", "month", "string/int"),
+        ("Scenario", "", "start_year", "2017", "period"),
+        ("Scenario", "", "end_year", "2017", "period"),
+        ("Scenario", "", "allow_partial_year", "1", "bool"),
+        ("Scenario", "", "incl_site_load", "1", "bool"),
+        ("Scenario", "", "time_series_filename", str(ts_path), "string"),
+        ("Finance", "", "npv_discount_rate", "7", "float"),
+        ("Finance", "", "inflation_rate", "3", "float"),
+        ("Battery", "1", "ch_max_rated", "1000", "float"),
+        ("Battery", "1", "dis_max_rated", "1000", "float"),
+        ("Battery", "1", "ene_max_rated", "4000", "float"),
+        ("Battery", "1", "rte", "85", "float"),
+        ("Battery", "1", "llsoc", "5", "float"),
+        ("Battery", "1", "ulsoc", "100", "float"),
+        ("Battery", "1", "soc_target", "50", "float"),
+        ("PV", "1", "rated_capacity", "3000", "float"),
+        ("PV", "1", "curtail", "1", "bool"),
+        ("DA", "", "growth", "0", "float"),
+    ]
+    df = pd.DataFrame(rows, columns=["Tag", "ID", "Key", "Value", "Type"])
+    df["Active"] = "yes"
+    params_path = out_dir / "params.csv"
+    df.to_csv(params_path, index=False)
+    payload_path = out_dir / "design_payload.json"
+    payload_path.write_text(json.dumps({"design": {
+        "parameters": str(params_path),
+        "der": "Battery", "kw": [500, 2000], "kwh": [1000, 8000],
+        "population": 6, "top_k": 2, "refine_rounds": 0}}))
+    return payload_path
+
+
+PORTFOLIO_PAYLOAD = {"portfolio": {
+    "synthetic_members": {"sites": 2, "hours": 48, "window": 24},
+    "export_cap_kw": 5000.0,
+    "gap_tol": 5e-3,
+    "max_outer": 8,
+}}
+
+
+def drop_spool_request(spool: Path, rid: str, payload_text: str) -> None:
+    """Atomically place a request file into a replica's incoming/ (the
+    serve scan must never see a partial write)."""
+    tmp = spool / "incoming" / f".{rid}.json.tmp"
+    tmp.write_text(payload_text)
+    os.replace(tmp, spool / "incoming" / f"{rid}.json")
+
+
+def await_spool_result(spool: Path, rid: str, timeout: float):
+    """Wait for the serve loop to finish ``rid`` (its input moves to
+    done/ only after results persist + the journal's terminal record)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if (spool / "done" / f"{rid}.json").exists():
+            return spool / "results" / rid
+        failed = list((spool / "failed").glob(f"{rid}*"))
+        assert not failed, \
+            f"{rid} parked in failed/: " + \
+            "; ".join(p.read_text()[:300] for p in failed
+                      if p.suffix == ".txt")
+        time.sleep(0.1)
+    raise AssertionError(f"{rid} not served within {timeout:.0f}s")
+
+
+def spawn_fleet(root: Path, tag: str, telemetry_on: bool):
+    from dervet_tpu.service import spawn_replica
+    env = {} if telemetry_on else {"DERVET_TPU_TELEMETRY": "0"}
+    reps = []
+    for i in range(3):
+        name = f"{tag}{i}"
+        logf = open(root / f"{name}.log", "w")
+        reps.append(spawn_replica(root / name, name=name, backend="cpu",
+                                  stdout=logf, stderr=logf, env=env))
+    return reps
+
+
+def csv_surface(results_dir: Path):
+    return {p.name: p.read_bytes()
+            for p in sorted(results_dir.glob("*.csv"))}
+
+
+def run_pass(root: Path, tag: str, telemetry_on: bool):
+    """Serve the full mixed workload on a fresh 3-replica fleet; return
+    ``(csvs_by_rid, wall_by_rid)``.  The in-process router honours the
+    same kill switch the replicas get via env."""
+    from dervet_tpu.service import FleetRouter
+    os.environ["DERVET_TPU_TELEMETRY"] = "1" if telemetry_on else "0"
+    root.mkdir()
+    reps = spawn_fleet(root, tag, telemetry_on)
+    router = FleetRouter(reps, fleet_dir=root / "fleet",
+                         heartbeat_timeout_s=5.0, tick_s=0.05).start()
+    csvs, wall = {}, {}
+    try:
+        # the mixed tail: one design + one portfolio request straight
+        # into two different replica spools (the serve scan admits them
+        # exactly like router .pkl payloads)
+        fixture_dir = root / "fixtures"
+        fixture_dir.mkdir()
+        design_payload = write_design_request(fixture_dir)
+        drop_spool_request(reps[1].spool, "dsgn", design_payload.read_text())
+        drop_spool_request(reps[2].spool, "pfol",
+                           json.dumps(PORTFOLIO_PAYLOAD))
+        t_submit = time.time()
+        futs = {rid: router.submit(cases, request_id=rid,
+                                   deadline_s=DEADLINE_S)
+                for rid, cases in workload().items()}
+        for rid, fut in futs.items():
+            res = fut.result(timeout=DEADLINE_S + 60)
+            wall[rid] = time.time() - t_submit
+            csvs[rid] = csv_surface(res.results_dir)
+        csvs["dsgn"] = csv_surface(
+            await_spool_result(reps[1].spool, "dsgn", DEADLINE_S))
+        csvs["pfol"] = csv_surface(
+            await_spool_result(reps[2].spool, "pfol", DEADLINE_S))
+        assert all(csvs.values()), \
+            f"empty CSV surface: {[r for r, c in csvs.items() if not c]}"
+        if telemetry_on:
+            # let one more heartbeat publish the post-completion
+            # registry state before the fleet goes down
+            time.sleep(1.5)
+    finally:
+        router.close()
+    return csvs, wall
+
+
+def main() -> int:
+    import tempfile
+
+    workdir = Path(tempfile.mkdtemp(prefix="telemetry-smoke-"))
+    report = {"scenario_requests": N_REQ, "mixed_kinds": 3}
+
+    # ---- pass 1: telemetry OFF (the kill-switch reference) -----------
+    log("pass 1: 3 replicas, DERVET_TPU_TELEMETRY=0 …")
+    t0 = time.time()
+    off_csvs, _ = run_pass(workdir / "off", "off", telemetry_on=False)
+    report["off_wall_s"] = round(time.time() - t0, 1)
+
+    # zero telemetry files: the kill switch writes NOTHING
+    stray = [str(p) for pat in ("trace.*.json", "telemetry.prom",
+                                "fleet_telemetry.prom")
+             for p in (workdir / "off").rglob(pat)]
+    assert not stray, f"kill switch leaked telemetry files: {stray}"
+    log(f"pass 1 OK: {len(off_csvs)} requests, zero telemetry files")
+
+    # ---- pass 2: telemetry ON ----------------------------------------
+    log("pass 2: 3 replicas, telemetry on …")
+    t0 = time.time()
+    on_root = workdir / "on"
+    on_csvs, wall = run_pass(on_root, "on", telemetry_on=True)
+    report["on_wall_s"] = round(time.time() - t0, 1)
+
+    # byte-identity: telemetry must observe, never perturb
+    assert set(on_csvs) == set(off_csvs)
+    for rid, ref in off_csvs.items():
+        got = on_csvs[rid]
+        assert sorted(got) == sorted(ref), \
+            f"{rid}: CSV file set differs between telemetry on/off"
+        for name in ref:
+            assert got[name] == ref[name], \
+                f"{rid}/{name}: bytes differ between telemetry on/off"
+    log("byte-identity OK: telemetry on == off across "
+        f"{sum(len(c) for c in off_csvs.values())} CSVs")
+
+    # every request produced a valid single-root span tree
+    from dervet_tpu.telemetry import trace as ttrace
+    from dervet_tpu.telemetry.ops import load_stitched_trace
+    n_spans = {}
+    service_lat = []        # replica-side `request` span durations
+    for rid in on_csvs:
+        spans = load_stitched_trace(rid, [on_root])
+        rep = ttrace.validate_trace(spans)
+        n_spans[rid] = rep["n_spans"]
+        names = {s["name"] for s in spans}
+        service_lat += [s["duration_s"] for s in spans
+                        if s["name"] == "request"
+                        and s.get("duration_s") is not None]
+        if rid.startswith("sc"):
+            assert rep["root"]["name"] == "fleet_request", rep["root"]
+            missing = {"transport", "batch_round",
+                       "dispatch_group"} - names
+            assert not missing, f"{rid}: hop chain missing {missing}"
+        elif rid == "dsgn":
+            assert "design_screen" in names, names
+        elif rid == "pfol":
+            assert "portfolio_dual_loop" in names, names
+    report["spans_per_request"] = n_spans
+    log(f"span trees OK: {n_spans}")
+
+    # Prometheus expositions parse; histogram merge is consistent
+    from dervet_tpu.telemetry import registry as treg
+    from dervet_tpu.telemetry.ops import fleet_status
+    per_replica = []
+    for i in range(3):
+        prom = on_root / f"on{i}" / "telemetry.prom"
+        assert prom.exists(), f"replica on{i} never published {prom}"
+        parsed = treg.parse_prometheus(prom.read_text())
+        assert parsed, f"{prom} parsed to nothing"
+        hist = treg.histogram_from_parsed(
+            parsed, "dervet_request_latency_seconds")
+        if hist:
+            per_replica.append(hist)
+    assert per_replica, "no replica published a latency histogram"
+    fleet = fleet_status([on_root])
+    assert fleet["n_replicas"] == 3 and fleet["n_up"] >= 1, fleet
+    merged = treg.merge_histograms(per_replica)
+    assert merged["count"] == sum(h["count"] for h in per_replica), \
+        "histogram merge lost observations"
+    # the merged count covers every request served by the fleet pass
+    assert merged["count"] >= len(on_csvs), \
+        f"latency histogram count {merged['count']} < " \
+        f"{len(on_csvs)} served requests"
+    merged_p50 = treg.quantile_from_buckets(merged, 0.5)
+    p50s = [treg.quantile_from_buckets(h, 0.5) for h in per_replica]
+    assert min(p50s) <= merged_p50 <= max(p50s), \
+        f"merged p50 {merged_p50} outside per-replica range {p50s}"
+    # agreement with the trace surface: the replica-side `request` span
+    # duration is measured around the same path the histogram observes,
+    # so the merged p50 must agree within the log-bucket resolution
+    # (x2 buckets -> x2.5 bracket).  The router-measured wall only
+    # upper-bounds it: spool transport + sibling queueing ride on top
+    # and balloon under host contention.
+    assert service_lat, "no replica-side request spans found"
+    span_p50 = sorted(service_lat)[len(service_lat) // 2]
+    assert span_p50 / 2.5 <= merged_p50 <= span_p50 * 2.5, \
+        f"merged latency p50 {merged_p50:.3f}s disagrees with the " \
+        f"request-span p50 {span_p50:.3f}s beyond bucket resolution"
+    measured = sorted(wall.values())[len(wall) // 2]
+    assert merged_p50 <= measured * 2.5, \
+        f"merged latency p50 {merged_p50:.3f}s exceeds the " \
+        f"router-measured wall p50 {measured:.3f}s"
+    report.update({
+        "latency_hist_count": merged["count"],
+        "latency_hist_p50_s": round(merged_p50, 4),
+        "measured_p50_s": round(measured, 4),
+        "request_span_p50_s": round(span_p50, 4),
+        "fleet_p50_s": fleet["latency_p50_s"],
+        "slo_attainment": fleet["slo_attainment"],
+    })
+    log(f"exposition OK: merged p50 {merged_p50:.2f}s vs measured "
+        f"{measured:.2f}s over {merged['count']} observations")
+
+    # ops CLIs exit 0 against the live artifacts
+    from dervet_tpu.telemetry.ops import status_main, trace_main
+    assert status_main([str(on_root)]) == 0
+    assert status_main([str(on_root), "--json"]) == 0
+    sc0 = sorted(r for r in on_csvs if r.startswith("sc"))[0]
+    chrome_out = workdir / "sc0.chrome.json"
+    assert trace_main([sc0, str(on_root),
+                       "--chrome", str(chrome_out)]) == 0
+    chrome = json.loads(chrome_out.read_text())
+    assert chrome.get("traceEvents"), "chrome export has no events"
+    assert trace_main(["dsgn", str(on_root)]) == 0
+    assert trace_main(["pfol", str(on_root)]) == 0
+    log("status/trace CLIs OK")
+
+    report["ok"] = True
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

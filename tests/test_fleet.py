@@ -296,7 +296,7 @@ class StubReplica(ReplicaHandle):
         self.export = None
 
     def submit(self, cases, rid, *, priority=0, deadline_epoch=None,
-               payload=None):
+               payload=None, trace_ctx=None):
         if self.reject_with is not None:
             self.reject_count += 1
             raise QueueFullError("stub full",
@@ -314,7 +314,7 @@ class StubReplica(ReplicaHandle):
             hb["probe_nonce"] = self.probes[-1]
         return hb
 
-    def probe(self, nonce):
+    def probe(self, nonce, trace=None):
         self.probes.append(nonce)
 
     def cancel(self, rid):
@@ -741,6 +741,28 @@ class TestSpoolFleet:
                 tmp_path / "victim" / "service_journal.jsonl")
             assert any(e["state"] == "admitted"
                        for e in states.values())
+            # failover-drill trace contract: the harvested/re-routed
+            # request yields ONE stitched trace (router slice + both
+            # replicas' exports merge under the router root) carrying
+            # the fence event plus harvest or re-route on the timeline
+            from dervet_tpu.telemetry import trace as ttrace
+            from dervet_tpu.telemetry.ops import load_stitched_trace
+            rid = recovered[0]
+            spans = load_stitched_trace(rid, [tmp_path])
+            report = ttrace.validate_trace(spans)
+            assert report["n_spans"] >= 3, spans
+            assert report["root"]["name"] == "fleet_request"
+            events = [e["name"] for s in spans
+                      for e in s.get("events") or ()]
+            assert "fence" in events, events
+            assert "harvest" in events or "reroute" in events, events
+            # the un-recovered request's trace must NOT carry failover
+            # events — fencing is attributed per request, not fleet-wide
+            other = next(r for r in results if r != rid)
+            other_events = [
+                e["name"] for s in load_stitched_trace(other, [tmp_path])
+                for e in s.get("events") or ()]
+            assert "reroute" not in other_events, other_events
         finally:
             router.close()
             for lg in logs:

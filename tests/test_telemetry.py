@@ -1,0 +1,719 @@
+"""Telemetry plane: tracing, metrics registry, ops surface.
+
+The observability contract under test:
+
+* **Spans** — zero-dependency span trees with monotonic durations, a
+  deterministic request-derived trace id, ambient + registry parenting,
+  single-root validation, cross-process stitching, and a Chrome
+  trace-event export with per-device lanes;
+* **Registry** — thread-safe counters/gauges/histograms on ONE fixed
+  log-bucket layout so percentiles merge exactly across replicas, a
+  Prometheus text exposition that round-trips through the parser, and
+  bounded ring-buffer time series;
+* **Kill switch** — ``DERVET_TPU_TELEMETRY=0`` records nothing, writes
+  nothing, and leaves result artifacts byte-identical;
+* **End to end** — a served request's trace covers admission → batch
+  round → dispatch group (ledger attributes attached) → certification,
+  a load-shed request's trace carries the degraded-fidelity marker, and
+  a LocalReplica fleet produces one stitched single-root trace per
+  request (the SIGKILL subprocess drill rides the existing
+  ``test_fleet.py`` crash test).
+"""
+import json
+import math
+import threading
+import time
+
+import pytest
+
+from dervet_tpu.benchlib import (synthetic_sensitivity_cases,
+                                 validate_telemetry_section)
+from dervet_tpu.telemetry import ops as tops
+from dervet_tpu.telemetry import registry as treg
+from dervet_tpu.telemetry import trace as tt
+
+
+def _cases(n=1, months=1, variant=0):
+    cases = synthetic_sensitivity_cases(n, months=months)
+    for c in cases:
+        for tag, _, keys in c.ders:
+            if tag == "Battery":
+                keys["ene_max_rated"] = \
+                    float(keys["ene_max_rated"]) + 0.5 * variant
+    return {i: c for i, c in enumerate(cases)}
+
+
+@pytest.fixture(autouse=True)
+def _clean_collector():
+    tt.COLLECTOR.reset()
+    yield
+    tt.COLLECTOR.reset()
+
+
+# ---------------------------------------------------------------------------
+# trace.py: spans, stitching, validation, chrome export
+# ---------------------------------------------------------------------------
+
+class TestTrace:
+    def test_trace_id_deterministic_and_rid_derived(self):
+        assert tt.trace_id_for("r1") == tt.trace_id_for("r1")
+        assert tt.trace_id_for("r1") != tt.trace_id_for("r2")
+        root = tt.start_span("request", rid="r1")
+        assert root.trace_id == tt.trace_id_for("r1")
+        root.end()
+
+    def test_kill_switch_records_nothing(self, monkeypatch, tmp_path):
+        monkeypatch.setenv(tt.ENV, "0")
+        sp = tt.start_span("request", rid="k1")
+        assert sp is tt.NOOP and not sp
+        assert sp.child("x") is sp and sp.event("e") is sp
+        assert sp.ctx() is None
+        with tt.span("block") as s:
+            assert s is tt.NOOP
+        assert tt.export_request_trace("k1", tmp_path) is None
+        assert not list(tmp_path.iterdir())
+        assert not treg.enabled()
+
+    def test_parenting_explicit_registry_and_ambient(self):
+        root = tt.start_span("request", rid="p1")
+        tt.register_request("p1", root)
+        # registry parenting (what resolve_group uses on worker threads)
+        child = tt.start_span("dispatch_group", rid="p1")
+        assert child.parent_id == root.span_id
+        assert child.trace_id == root.trace_id
+        # ambient parenting
+        with tt.span("outer") as outer:
+            inner = tt.start_span("inner")
+            assert inner.parent_id == outer.span_id
+            inner.end()
+        # context-dict parenting (the transport payload shape)
+        remote = tt.start_span("request", parent=root.ctx())
+        assert remote.trace_id == root.trace_id
+        assert remote.parent_id == root.span_id
+        for s in (child, remote, root):
+            s.end()
+        tt.release_request("p1")
+
+    def test_registry_parenting_crosses_threads(self):
+        root = tt.start_span("request", rid="thr")
+        tt.register_request("thr", root)
+        got = {}
+
+        def worker():
+            sp = tt.start_span("dispatch_group", rid="thr")
+            got["parent"] = sp.parent_id
+            sp.end()
+
+        th = threading.Thread(target=worker)
+        th.start()
+        th.join()
+        assert got["parent"] == root.span_id
+        root.end()
+        tt.release_request("thr")
+
+    def test_durations_monotonic_and_error_status(self):
+        sp = tt.start_span("s")
+        time.sleep(0.01)
+        sp.end(error=ValueError("boom"))
+        assert sp.duration_s >= 0.01
+        assert sp.status == "error"
+        assert "ValueError" in sp.attrs["error"]
+
+    def test_validate_trace_contracts(self):
+        root = tt.start_span("request", rid="v1")
+        kid = tt.start_span("child", parent=root)
+        kid.end()
+        root.end()
+        spans = tt.COLLECTOR.spans(tt.trace_id_for("v1"))
+        info = tt.validate_trace(spans)
+        assert info["n_spans"] == 2
+        assert info["root"]["name"] == "request"
+        # two parentless spans -> not a valid single-root trace
+        bad = spans + [{"trace_id": spans[0]["trace_id"],
+                        "span_id": "zz", "parent_id": None,
+                        "name": "orphan", "t_start": 0.0,
+                        "duration_s": 0.0, "status": "ok"}]
+        with pytest.raises(ValueError, match="exactly one root"):
+            tt.validate_trace(bad)
+        with pytest.raises(ValueError, match="no spans"):
+            tt.validate_trace([])
+
+    def test_merge_dedupes_and_build_tree_stitches(self):
+        root = tt.start_span("request", rid="m1").end()
+        orphan = {"trace_id": root.trace_id, "span_id": "orph",
+                  "parent_id": "gone", "name": "late", "t_start":
+                  root.t_start + 1, "duration_s": 0.0, "status": "ok"}
+        spans = tt.merge_spans([
+            tt.COLLECTOR.spans(root.trace_id),
+            tt.COLLECTOR.spans(root.trace_id),     # duplicate export
+            [orphan]])
+        assert len(spans) == 2
+        troot, children = tt.build_tree(spans)
+        assert troot["span_id"] == root.span_id
+        kids = children[root.span_id]
+        assert kids[0]["span_id"] == "orph"
+        assert "stitched" in kids[0]["attrs"]
+
+    def test_slowest_path_descends_longest_child(self):
+        root = tt.start_span("r", rid="sp")
+        fast = tt.start_span("fast", parent=root)
+        slow = tt.start_span("slow", parent=root)
+        leaf = tt.start_span("leaf", parent=slow)
+        for s, d in ((leaf, 0.05), (slow, 0.2), (fast, 0.01)):
+            s.duration_s = d
+            s._ended = True
+            tt.COLLECTOR.add(s)
+        root.end()
+        spans = tt.COLLECTOR.spans(root.trace_id)
+        path = tt.slowest_path(spans)
+        assert path == [root.span_id, slow.span_id, leaf.span_id]
+
+    def test_chrome_export_device_lanes(self, tmp_path):
+        root = tt.start_span("request", rid="ch").end()
+        spans = [root.to_dict(),
+                 {**root.to_dict(), "span_id": "d0",
+                  "parent_id": root.span_id,
+                  "attrs": {"device": 0}},
+                 {**root.to_dict(), "span_id": "d1",
+                  "parent_id": root.span_id,
+                  "attrs": {"device": 1}}]
+        doc = tt.to_chrome(spans, "ch")
+        lanes = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "M"}
+        assert {"request", "device:0", "device:1"} <= lanes
+        path = tt.export_chrome_trace(spans, tmp_path / "c.json", "ch")
+        loaded = json.loads(path.read_text())
+        assert loaded["traceEvents"]
+
+    def test_export_pops_and_collector_bounded(self, tmp_path):
+        root = tt.start_span("request", rid="ex").end()
+        p = tt.export_request_trace("ex", tmp_path)
+        doc = json.loads(p.read_text())
+        assert doc["trace_id"] == tt.trace_id_for("ex")
+        assert doc["spans"][0]["name"] == "request"
+        # popped: a second export finds nothing
+        assert tt.export_request_trace("ex", tmp_path) is None
+
+    def test_merge_export_unions_late_spans(self, tmp_path):
+        """A span ending after its trace was exported (hedge/failover
+        loser) re-enters the collector; merge=True re-export records it
+        in the file and frees the orphan entry."""
+        tid = tt.trace_id_for("lt")
+        tt.start_span("request", rid="lt").end()
+        late = tt.start_span("transport", trace_id=tid)
+        tt.export_request_trace("lt", tmp_path)
+        late.end()                  # orphan collector entry under tid
+        assert tt.COLLECTOR.spans(tid)
+        p = tt.export_request_trace("lt", tmp_path, merge=True)
+        doc = json.loads(p.read_text())
+        assert {s["name"] for s in doc["spans"]} == {"request",
+                                                     "transport"}
+        assert not tt.COLLECTOR.spans(tid)      # slot freed
+
+
+# ---------------------------------------------------------------------------
+# registry.py: metrics, merge exactness, exposition round trip
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_gauge_histogram_basics(self):
+        reg = treg.MetricsRegistry()
+        reg.counter("c").inc()
+        reg.counter("c").inc(2)
+        assert reg.counter("c").value == 3
+        with pytest.raises(ValueError):
+            reg.counter("c").inc(-1)
+        reg.gauge("g", replica="a").set(4.5)
+        assert reg.gauge("g", replica="a").value == 4.5
+        reg.histogram("h").observe(0.5)
+        snap = reg.histogram("h").snapshot()
+        assert snap["count"] == 1 and snap["sum"] == 0.5
+        # same name different type is a hard error
+        with pytest.raises(TypeError):
+            reg.gauge("c")
+
+    def test_labels_key_separate_series(self):
+        reg = treg.MetricsRegistry()
+        reg.counter("w", grade="exact").inc(3)
+        reg.counter("w", grade="cold").inc(1)
+        assert reg.counter("w", grade="exact").value == 3
+        assert reg.counter("w", grade="cold").value == 1
+
+    def test_histogram_merge_is_exact_bucket_add(self):
+        a, b = treg.Histogram("h", {}), treg.Histogram("h", {})
+        obs_a = [0.001, 0.5, 2.0, 100.0]
+        obs_b = [0.002, 0.25, 3.0]
+        a.observe_many(obs_a)
+        b.observe_many(obs_b)
+        merged = treg.merge_histograms([a.snapshot(), b.snapshot()])
+        ref = treg.Histogram("h", {})
+        ref.observe_many(obs_a + obs_b)
+        assert merged["buckets"] == ref.snapshot()["buckets"]
+        assert merged["count"] == 7
+        assert math.isclose(merged["sum"], sum(obs_a + obs_b))
+        # quantiles computed from the merge equal the single-histogram
+        # quantiles — the fleet p50/p99 surface is exact, not stacked
+        # approximation
+        for q in (0.5, 0.99):
+            assert treg.quantile_from_buckets(merged, q) == \
+                treg.quantile_from_buckets(ref.snapshot(), q)
+
+    def test_merge_rejects_foreign_layout(self):
+        with pytest.raises(ValueError, match="layout"):
+            treg.merge_histograms([{"count": 1, "sum": 1.0,
+                                    "buckets": [1, 0], "overflow": 0}])
+
+    def test_quantile_brackets_observation(self):
+        h = treg.Histogram("h", {})
+        h.observe_many([0.8] * 100)
+        p50 = treg.quantile_from_buckets(h.snapshot(), 0.5)
+        # log-bucket resolution: the estimate lands inside the
+        # observation's bucket (factor-2 wide)
+        assert 0.4 <= p50 <= 1.7
+
+    def test_prometheus_round_trip(self):
+        reg = treg.MetricsRegistry()
+        reg.counter("dervet_requests_total", outcome="completed").inc(5)
+        reg.gauge("dervet_queue_depth").set(3)
+        reg.histogram("dervet_request_latency_seconds").observe_many(
+            [0.01, 0.2, 0.2, 4.0])
+        text = reg.to_prometheus()
+        parsed = treg.parse_prometheus(text)
+        assert treg.sample_value(parsed, "dervet_requests_total",
+                                 {"outcome": "completed"}) == 5
+        assert treg.sample_value(parsed, "dervet_queue_depth") == 3
+        hist = treg.histogram_from_parsed(
+            parsed, "dervet_request_latency_seconds")
+        orig = reg.histogram("dervet_request_latency_seconds").snapshot()
+        assert hist["buckets"] == orig["buckets"]
+        assert hist["count"] == orig["count"]
+        with pytest.raises(ValueError, match="unparseable"):
+            treg.parse_prometheus("not a metric line !!!")
+
+    def test_label_escaping_round_trips(self):
+        # caller-chosen names (replicas, breakers) may carry quotes /
+        # backslashes / newlines — the exposition must stay parseable
+        # and the values must survive the round trip
+        awkward = 'we"ird\\na\nme'
+        reg = treg.MetricsRegistry()
+        reg.counter("dervet_breaker_trips_total",
+                    replica=awkward).inc(2)
+        parsed = treg.parse_prometheus(reg.to_prometheus())
+        assert treg.sample_value(parsed, "dervet_breaker_trips_total",
+                                 {"replica": awkward}) == 2
+
+    def test_foreign_bucket_layout_reads_as_unpublished(self):
+        # a mixed-version replica publishing different bounds must come
+        # back as "no histogram", never be snapped onto HIST_BOUNDS
+        # (a remapped reconstruction would pass merge_histograms'
+        # layout check and silently corrupt fleet percentiles)
+        text = "\n".join([
+            'h_bucket{le="0.15"} 1',
+            'h_bucket{le="0.33"} 3',
+            'h_bucket{le="+Inf"} 3',
+            "h_count 3", "h_sum 0.5", ""])
+        assert treg.histogram_from_parsed(
+            treg.parse_prometheus(text), "h") is None
+        # the fixed layout itself still reconstructs
+        good = treg.MetricsRegistry()
+        good.histogram("h").observe_many([0.01, 0.2])
+        parsed = treg.parse_prometheus(good.to_prometheus())
+        assert treg.histogram_from_parsed(parsed, "h")["count"] == 2
+
+    def test_write_prom_atomic_no_tmp_left(self, tmp_path):
+        reg = treg.MetricsRegistry()
+        reg.counter("c").inc()
+        path = reg.write_prom(tmp_path / "telemetry.prom")
+        assert path.read_text().startswith("# TYPE c counter")
+        assert not list(tmp_path.glob(".*tmp"))
+
+    def test_series_ring_buffer_bounded(self):
+        reg = treg.MetricsRegistry()
+        g = reg.gauge("depth")
+        for i in range(treg.SERIES_CAP + 10):
+            g.set(i)
+            reg.sample()
+        series = reg.series("depth")
+        assert len(series) == treg.SERIES_CAP
+        assert series[-1][1] == treg.SERIES_CAP + 9
+
+    def test_snapshot_validates_with_benchlib(self):
+        reg = treg.MetricsRegistry()
+        reg.counter("c").inc()
+        reg.gauge("g").set(1)
+        reg.histogram("h").observe(0.1)
+        snap = validate_telemetry_section(reg.snapshot())
+        assert snap["counters"]["c"] == 1
+        bad = dict(snap)
+        bad["hist_bounds"] = 3
+        with pytest.raises(ValueError, match="hist_bounds"):
+            validate_telemetry_section(bad)
+
+    def test_http_endpoint_serves_exposition(self):
+        import urllib.request
+        reg = treg.MetricsRegistry()
+        reg.counter("hits").inc(7)
+        port = reg.serve_http(0)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5).read()
+            parsed = treg.parse_prometheus(body.decode())
+            assert treg.sample_value(parsed, "hits") == 7
+        finally:
+            reg.stop_http()
+
+
+# ---------------------------------------------------------------------------
+# ops.py: status / trace CLI surfaces
+# ---------------------------------------------------------------------------
+
+def _fake_spool(tmp_path, name, depth=2, drain=1.5, lat=(0.2, 0.4)):
+    spool = tmp_path / name
+    spool.mkdir()
+    (spool / "heartbeat.json").write_text(json.dumps({
+        "t": time.time(), "name": name, "draining": False,
+        "pending": 1, "queue_depth": depth, "completed": 3, "failed": 0}))
+    reg = treg.MetricsRegistry()
+    reg.gauge(tops.M_QUEUE_DEPTH).set(depth)
+    reg.gauge(tops.M_DRAIN_RATE).set(drain)
+    reg.counter(tops.M_WARM, grade="exact").inc(4)
+    reg.counter(tops.M_WARM, grade="cold").inc(1)
+    reg.histogram(tops.M_REQ_LATENCY).observe_many(lat)
+    reg.write_prom(spool / tops.PROM_FILE)
+    return spool
+
+
+class TestOpsStatus:
+    def test_replica_status_reads_published_artifacts(self, tmp_path):
+        spool = _fake_spool(tmp_path, "r0")
+        st = tops.replica_status(spool)
+        assert st["state"] == "up"
+        assert st["queue_depth"] == 2
+        assert st["drain_rate_rps"] == 1.5
+        assert st["warm_hit_rate"] == 0.8
+        assert st["latency_p50_s"] is not None
+
+    def test_fleet_status_merges_histograms(self, tmp_path):
+        _fake_spool(tmp_path, "r0", lat=(0.1, 0.1))
+        _fake_spool(tmp_path, "r1", lat=(0.1, 0.1))
+        fleet = tops.fleet_status([tmp_path], slo_s=1.0)
+        assert fleet["n_replicas"] == 2 and fleet["n_up"] == 2
+        assert fleet["queue_depth_total"] == 4
+        # 4 observations all ~0.1s: merged p50 in the 0.1 bucket, SLO
+        # attainment 100%
+        assert 0.05 <= fleet["latency_p50_s"] <= 0.22
+        assert fleet["slo_attainment"] == 1.0
+
+    def test_status_cli_exits_zero(self, tmp_path, capsys):
+        _fake_spool(tmp_path, "r0")
+        assert tops.status_main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "r0" in out and "fleet:" in out
+        assert tops.status_main([str(tmp_path), "--json"]) == 0
+        json.loads(capsys.readouterr().out)
+
+    def test_missing_spool_is_unknown_not_crash(self, tmp_path):
+        fleet = tops.fleet_status([tmp_path / "nope"])
+        assert fleet["n_replicas"] == 0
+
+
+class TestOpsTrace:
+    def _export(self, tmp_path, rid="x1"):
+        root = tt.start_span("fleet_request", rid=rid)
+        tt.start_span("transport", parent=root).end()
+        root.event("fence", replica="r0").end()
+        return tt.export_request_trace(rid, tmp_path / "traces")
+
+    def test_trace_cli_stitches_and_exits_zero(self, tmp_path, capsys):
+        self._export(tmp_path)
+        assert tops.trace_main(["x1", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "fleet_request" in out and "transport" in out
+        assert "slowest root-to-leaf" in out
+
+    def test_trace_cli_chrome_out(self, tmp_path, capsys):
+        self._export(tmp_path)
+        chrome = tmp_path / "out.chrome.json"
+        assert tops.trace_main(["x1", str(tmp_path),
+                                "--chrome", str(chrome)]) == 0
+        assert json.loads(chrome.read_text())["traceEvents"]
+
+    def test_trace_cli_missing_rid_exit_3(self, tmp_path):
+        assert tops.trace_main(["ghost", str(tmp_path)]) == 3
+
+    def test_journal_fallback_reconstructs_timeline(self, tmp_path):
+        from dervet_tpu.service.journal import ServiceJournal
+        j = ServiceJournal(tmp_path / "service_journal.jsonl")
+        j.admitted("r9", "r9.pkl", trace_id=tt.trace_id_for("r9"))
+        j.completed("r9", trace_id=tt.trace_id_for("r9"))
+        j.close()
+        spans = tops.journal_spans("r9", [tmp_path])
+        info = tt.validate_trace(spans)
+        assert info["root"]["name"] == "journal_timeline"
+        names = {s["name"] for s in spans}
+        assert {"journal:admitted", "journal:completed"} <= names
+        assert spans[0]["trace_id"] == tt.trace_id_for("r9")
+
+
+# ---------------------------------------------------------------------------
+# journal satellite: wall+mono pair, trace ids, tolerant replay
+# ---------------------------------------------------------------------------
+
+class TestJournalTimestamps:
+    def test_records_carry_wall_mono_and_trace_id(self, tmp_path):
+        from dervet_tpu.service.journal import ServiceJournal
+        j = ServiceJournal(tmp_path / "j.jsonl")
+        j.admitted("a", "a.csv", trace_id="t" * 32)
+        j.completed("a", trace_id="t" * 32)
+        j.close()
+        recs = [json.loads(ln) for ln in
+                (tmp_path / "j.jsonl").read_text().splitlines()]
+        for rec in recs:
+            assert "t" in rec and "mono" in rec
+            assert rec["trace_id"] == "t" * 32
+        # mono never steps backwards within one incarnation
+        assert recs[1]["mono"] >= recs[0]["mono"]
+
+    def test_replay_tolerates_pre_telemetry_records(self, tmp_path):
+        from dervet_tpu.service.journal import ServiceJournal
+        path = tmp_path / "j.jsonl"
+        # a PR-13-era journal: no mono, no trace_id
+        path.write_text(
+            '{"event": "admitted", "rid": "old", "t": 1.0, '
+            '"file": "old.csv"}\n'
+            '{"event": "completed", "rid": "old", "t": 2.0}\n')
+        states = ServiceJournal.replay_path(path)
+        assert states["old"]["state"] == "completed"
+        assert "trace_id" not in states["old"]
+        j = ServiceJournal(path)          # append to the old journal
+        j.failed("new", {"message": "x"}, trace_id="abc")
+        j.close()
+        states = ServiceJournal.replay_path(path)
+        assert states["new"]["trace_id"] == "abc"
+        assert states["old"]["state"] == "completed"
+
+
+# ---------------------------------------------------------------------------
+# End to end: a served request's trace + registry population
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def served_trace():
+    """One request through a cpu ScenarioService, trace captured."""
+    from dervet_tpu.service import ScenarioService
+    tt.COLLECTOR.reset()
+    svc = ScenarioService(backend="cpu", max_wait_s=0.0)
+    fut = svc.submit(_cases(1), request_id="tr1")
+    svc.run_once()
+    res = fut.result(timeout=0)
+    spans = tt.COLLECTOR.spans(tt.trace_id_for("tr1"))
+    svc.close()
+    return res, spans
+
+
+class TestServiceTracing:
+    def test_single_root_covers_the_hop_chain(self, served_trace):
+        _, spans = served_trace
+        info = tt.validate_trace(spans)
+        assert info["root"]["name"] == "request"
+        names = {s["name"] for s in spans}
+        assert {"request", "admission", "batch_round", "dispatch_group",
+                "certify"} <= names
+
+    def test_dispatch_group_span_carries_ledger_attrs(self, served_trace):
+        res, spans = served_trace
+        grp = next(s for s in spans if s["name"] == "dispatch_group")
+        attrs = grp["attrs"]
+        # the solve-ledger entry is the attribute payload
+        for key in ("rung", "backend", "batch", "solve_s", "windows"):
+            assert key in attrs, key
+        assert attrs["rung"] == "initial"
+        assert "tr1" in attrs["requests"]
+        led = res.solve_ledger
+        assert attrs["batch"] == led["groups"][0]["batch"]
+
+    def test_admission_span_measures_queue_wait(self, served_trace):
+        _, spans = served_trace
+        adm = next(s for s in spans if s["name"] == "admission")
+        assert adm["duration_s"] >= 0
+        assert adm["attrs"]["queue_wait_s"] == pytest.approx(
+            adm["duration_s"], abs=1e-6)
+
+    def test_registry_populated_from_round(self, served_trace):
+        reg = treg.get_registry()
+        snap = reg.snapshot()
+        assert snap["counters"].get("dervet_rounds_total", 0) >= 1
+        assert snap["counters"].get(
+            'dervet_requests_total{outcome="completed"}', 0) >= 1
+        hist = snap["histograms"].get("dervet_request_latency_seconds")
+        assert hist and hist["count"] >= 1
+        # certification verdicts feed the registry (the status CLI's
+        # cert%% column reads this series)
+        assert snap["counters"].get(
+            'dervet_certifications_total{verdict="accepted"}', 0) >= 1
+        validate_telemetry_section(snap)
+
+    def test_load_shed_trace_carries_degraded_marker(self):
+        from dervet_tpu.service import ScenarioService
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0,
+                              max_queue_depth=8, max_batch_requests=4,
+                              shed_threshold_frac=0.5,
+                              shed_sustain_rounds=1)
+        futs = {}
+        for i in range(8):
+            futs[i] = svc.submit(_cases(1), request_id=f"sh{i}",
+                                 priority=(1 if i % 2 else 0))
+        while svc.queue.depth():
+            svc.run_once()
+        shed_rid = next(f"sh{i}" for i, f in futs.items()
+                        if f.result(0).fidelity == "degraded")
+        spans = tt.COLLECTOR.spans(tt.trace_id_for(shed_rid))
+        svc.close()
+        tt.validate_trace(spans)
+        root = next(s for s in spans if s["name"] == "request")
+        assert root["attrs"].get("fidelity") == "degraded"
+        rnd = next(s for s in spans if s["name"] == "batch_round")
+        assert rnd["attrs"]["fidelity"] == "degraded"
+        assert any(e["name"] == "load_shed"
+                   for e in rnd.get("events", ()))
+
+    def test_kill_switch_results_byte_identical(self, tmp_path,
+                                                monkeypatch):
+        from dervet_tpu.service import ScenarioService
+
+        def serve(out_dir):
+            svc = ScenarioService(backend="cpu", max_wait_s=0.0)
+            fut = svc.submit(_cases(1), request_id="ks")
+            svc.run_once()
+            res = fut.result(timeout=0)
+            res.save_as_csv(out_dir)
+            svc.close()
+            return {p.name: p.read_bytes()
+                    for p in sorted(out_dir.glob("*.csv"))}
+
+        on = serve(tmp_path / "on")
+        assert tt.COLLECTOR.spans(tt.trace_id_for("ks"))
+        tt.COLLECTOR.reset()
+        monkeypatch.setenv(tt.ENV, "0")
+        off = serve(tmp_path / "off")
+        assert on and on == off
+        assert tt.COLLECTOR.spans(tt.trace_id_for("ks")) == []
+
+
+# ---------------------------------------------------------------------------
+# Fleet: stitched traces + published-load routing (in-process replicas)
+# ---------------------------------------------------------------------------
+
+class TestFleetTelemetry:
+    def test_local_fleet_single_stitched_trace(self):
+        from dervet_tpu.service import ScenarioService
+        from dervet_tpu.service.fleet import LocalReplica
+        from dervet_tpu.service.router import FleetRouter
+        svcs = [ScenarioService(backend="cpu", max_wait_s=0.0)
+                for _ in range(2)]
+        reps = [LocalReplica(f"lr{i}", s) for i, s in enumerate(svcs)]
+        router = FleetRouter(reps, heartbeat_timeout_s=5.0,
+                             tick_s=0.02).start()
+        try:
+            fut = router.submit(_cases(1), request_id="fl1",
+                                deadline_s=300.0)
+            deadline = time.monotonic() + 120
+            while not fut.done() and time.monotonic() < deadline:
+                for s in svcs:
+                    s.run_once()
+                time.sleep(0.01)
+            res = fut.result(timeout=1)
+            assert res.result is not None
+            # ONE trace: the replica's spans parent under the router's
+            # root via the transport context — single root, full chain
+            spans = tt.COLLECTOR.spans(tt.trace_id_for("fl1"))
+            info = tt.validate_trace(spans)
+            assert info["root"]["name"] == "fleet_request"
+            names = {s["name"] for s in spans}
+            assert {"fleet_request", "transport", "request",
+                    "admission", "batch_round",
+                    "dispatch_group"} <= names
+            root = info["root"]
+            assert any(e["name"] == "routed"
+                       for e in root.get("events", ()))
+        finally:
+            router.close()
+            for s in svcs:
+                s.close()
+
+    def test_published_load_outranks_inflight(self):
+        from dervet_tpu.service.router import FleetRouter
+        from tests.test_fleet import StubReplica
+        a, b = StubReplica("a"), StubReplica("b")
+        router = FleetRouter([a, b], heartbeat_timeout_s=5.0,
+                             tick_s=1000.0)   # no monitor interference
+        # a never published -> inflight fallback tier (sorts after b)
+        router._pub_load["b"] = {"queue_depth": 0.0,
+                                 "drain_rate_rps": 2.0, "pending": 0.0}
+        assert router._load_score("a")[0] == 1
+        assert router._load_score("b")[0] == 0
+        # published backlog ranks by estimated drain seconds
+        router._pub_load["a"] = {"queue_depth": 8.0,
+                                 "drain_rate_rps": 2.0, "pending": 0.0}
+        assert router._load_score("a")[1] == pytest.approx(4.0)
+        assert router._load_score("b")[1] == pytest.approx(0.0)
+        fut = router.submit(_stub_cases_small(), request_id="lr1")
+        assert "lr1" in b.reqs and "lr1" not in a.reqs
+        assert not fut.done()
+        router.close(terminate_replicas=False)
+
+    def test_stale_publication_falls_back_to_inflight(self):
+        from dervet_tpu.service.router import FleetRouter
+        from tests.test_fleet import StubReplica
+        a, b = StubReplica("a"), StubReplica("b")
+        router = FleetRouter([a, b], heartbeat_timeout_s=5.0,
+                             tick_s=1000.0)
+        # a frozen exposition (dead replica, or one respawned with
+        # telemetry off) must not keep ranking as idle: a stale
+        # t_published demotes to the inflight fallback tier
+        router._pub_load["b"] = {
+            "queue_depth": 0.0, "drain_rate_rps": 2.0, "pending": 0.0,
+            "t_published": time.time() - 10 * router._pub_stale_s}
+        assert router._load_score("b")[0] == 1
+        router._pub_load["b"]["t_published"] = time.time()
+        assert router._load_score("b")[0] == 0
+        # local-transport signals carry no t_published (read live) —
+        # they never go stale
+        router._pub_load["a"] = {"queue_depth": 1.0,
+                                 "drain_rate_rps": 1.0, "pending": 0.0}
+        assert router._load_score("a")[0] == 0
+        router.close(terminate_replicas=False)
+
+    def test_local_replica_publishes_live_queue(self):
+        from dervet_tpu.service import ScenarioService
+        from dervet_tpu.service.fleet import LocalReplica
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0)
+        rep = LocalReplica("pub", svc)
+        pub = rep.published_load()
+        assert pub is not None and pub["queue_depth"] == 0
+        rep.kill()
+        assert rep.published_load() is None
+        svc._fail_pending()
+
+    def test_spool_payload_carries_trace_context(self, tmp_path):
+        import pickle
+        from dervet_tpu.service.fleet import SpoolReplica
+        ctx = {"trace_id": "t" * 32, "span_id": "s1"}
+        blob = SpoolReplica.encode_payload(
+            {"0": None}, priority=1, deadline_epoch=None, trace=ctx)
+        assert pickle.loads(blob)["trace"] == ctx
+        # probe file carries the context too (heartbeat echo path)
+        rep = SpoolReplica("r", tmp_path)
+        rep.probe("n1", trace=ctx)
+        doc = json.loads((tmp_path / "probe.json").read_text())
+        assert doc["nonce"] == "n1" and doc["trace"] == ctx
+
+
+_STUB_CASES = None
+
+
+def _stub_cases_small():
+    global _STUB_CASES
+    if _STUB_CASES is None:
+        _STUB_CASES = _cases(1)
+    return _STUB_CASES
