@@ -291,6 +291,11 @@ def main() -> None:
             legs["portfolio"] = portfolio_leg()
         except Exception as e:          # noqa: BLE001
             legs["portfolio"] = {"error": str(e)[:300]}
+    if int(os.environ.get("BENCH_PORTFOLIO_SCALE", "1")):
+        try:
+            legs["portfolio_scale"] = portfolio_scale_leg()
+        except Exception as e:          # noqa: BLE001
+            legs["portfolio_scale"] = {"error": str(e)[:300]}
     config["legs"] = legs
 
     # scale the target linearly if running fewer scenarios than the baseline
@@ -1794,6 +1799,179 @@ def portfolio_leg() -> dict:
                     ("round", "iters_p50", "seeded", "dual_iterate",
                      "substituted", "compile_events", "gap_rel",
                      "wall_s")} for r in res.rounds],
+        "gates": gates,
+        "gated_on_real_mesh": real_mesh,
+    }
+
+
+def portfolio_scale_leg() -> dict:
+    """Portfolio scale-out proof (``legs.portfolio_scale``, PR 15): the
+    two compounding wall-time attacks on the dual loop, A/B'd at the
+    BENCH_r07 64-site shape.
+
+    (1) STABILIZED MASTER: the in-out / proximal-level dual step
+    (``PortfolioSpec.master_stabilization``) vs the PR-13 three-regime
+    control (``DERVET_TPU_PORTFOLIO_STABILIZE=0``) — outer rounds to
+    the 1e-3 gap, gate >= 40% fewer.
+
+    (2) FLEET-SHARDED ROUNDS: one dual round's member batch split into
+    N structure-aware shards dispatched concurrently (the in-process
+    executor; the fleet-replica transport is drilled by
+    ``scripts/portfolio_fleet_smoke.py``) — amortized windows/s vs the
+    monolithic round at a FIXED round budget.  The throughput gate is
+    ``gated_on_real_mesh``: CPU CI time-slices one socket across the
+    shard workers and proves structure, not scaling.
+
+    Plus the parity gate both attacks must preserve: on the exact cpu
+    backend a sharded solve's answer (duals, aggregate, objective) is
+    IDENTICAL to the monolithic one for a fixed shard plan — per-site
+    columns and costs do not depend on which shard solved them."""
+    import numpy as _np
+
+    from dervet_tpu.portfolio import PortfolioSpec, solve_portfolio
+    from dervet_tpu.portfolio.service import synthetic_portfolio_members
+    from dervet_tpu.portfolio.solve import validate_portfolio_section
+
+    import jax as _jax
+    sites = int(os.environ.get("BENCH_PFSCALE_SITES", "64"))
+    hours = int(os.environ.get("BENCH_PFSCALE_HOURS", "336"))
+    window = int(os.environ.get("BENCH_PFSCALE_WINDOW", "168"))
+    gap_tol = float(os.environ.get("BENCH_PFSCALE_GAP", "1e-3"))
+    max_outer = int(os.environ.get("BENCH_PFSCALE_MAX_OUTER", "40"))
+    n_shards = int(os.environ.get("BENCH_PFSCALE_SHARDS", "4"))
+    shard_rounds = int(os.environ.get("BENCH_PFSCALE_SHARD_ROUNDS", "4"))
+
+    def members():
+        return synthetic_portfolio_members(sites, hours=hours,
+                                           window=window)
+
+    probe = solve_portfolio(
+        PortfolioSpec(members=members(), export_cap_kw=1e9, max_outer=1),
+        backend="jax")
+    cap = float(probe.aggregate["net_export"].max()) - 500.0 * sites
+
+    def spec(**kw):
+        base = dict(export_cap_kw=cap, max_outer=max_outer,
+                    gap_tol=gap_tol)
+        base.update(kw)
+        return PortfolioSpec(members=members(), **base)
+
+    # ---- A/B 1: stabilized vs three-regime control -------------------
+    # the switch is read per call, so a value left in the operator's
+    # environment would silently turn the "stabilized" arm into a
+    # second control — clear it for the A arm, force "0" for B, restore
+    env_key = "DERVET_TPU_PORTFOLIO_STABILIZE"
+    env_prev = os.environ.pop(env_key, None)
+    try:
+        t0 = time.time()
+        stab = solve_portfolio(spec(), backend="jax")
+        t_stab = time.time() - t0
+        validate_portfolio_section(stab.run_health["portfolio"])
+        check_kernel_gate(stab.solve_ledger, "portfolio_scale")
+        os.environ[env_key] = "0"
+        t0 = time.time()
+        ctrl = solve_portfolio(spec(), backend="jax")
+        t_ctrl = time.time() - t0
+    finally:
+        if env_prev is None:
+            os.environ.pop(env_key, None)
+        else:
+            os.environ[env_key] = env_prev
+    rounds_cut = (1.0 - stab.outer_rounds / ctrl.outer_rounds
+                  if ctrl.outer_rounds else 0.0)
+    regimes: dict = {}
+    for r in stab.rounds:
+        regimes[str(r["regime"])] = regimes.get(str(r["regime"]), 0) + 1
+
+    # ---- A/B 2: sharded vs monolithic rounds at a fixed budget -------
+    t0 = time.time()
+    mono = solve_portfolio(spec(max_outer=shard_rounds, gap_tol=1e-12),
+                           backend="jax")
+    t_mono = time.time() - t0
+    t0 = time.time()
+    shrd = solve_portfolio(spec(max_outer=shard_rounds, gap_tol=1e-12,
+                                shards=n_shards), backend="jax")
+    t_shard = time.time() - t0
+    mono_w = sum(int(r["windows"]) for r in mono.rounds)
+    shard_w = sum(int(r["windows"]) for r in shrd.rounds)
+    mono_wps = mono_w / t_mono
+    shard_wps = shard_w / t_shard
+    # per-round wall with round 0 (compiles) dropped: the steady-state
+    # per-round-wall / shards quotient the headline number multiplies
+    mono_round_s = float(_np.mean([r["wall_s"]
+                                   for r in mono.rounds[1:]])) \
+        if len(mono.rounds) > 1 else float("nan")
+    shard_round_s = float(_np.mean([r["wall_s"]
+                                    for r in shrd.rounds[1:]])) \
+        if len(shrd.rounds) > 1 else float("nan")
+
+    # ---- parity: sharded == monolithic bytes on the exact backend ----
+    small = synthetic_portfolio_members(16, hours=48, window=24,
+                                        seed=0, pv_kw=9000.0)
+    sprobe = solve_portfolio(
+        PortfolioSpec(members=dict(small), export_cap_kw=1e9,
+                      max_outer=1), backend="cpu")
+    scap = float(sprobe.aggregate["net_export"].max()) - 4000.0
+    pkw = dict(export_cap_kw=scap, gap_tol=1e-6, feas_tol=1e-7,
+               max_outer=40)
+    pm = solve_portfolio(PortfolioSpec(members=dict(small), **pkw),
+                         backend="cpu")
+    psh = solve_portfolio(PortfolioSpec(members=dict(small),
+                                        shards=n_shards, **pkw),
+                          backend="cpu")
+    parity_rel = abs(pm.primal_objective - psh.primal_objective) \
+        / (1.0 + abs(pm.primal_objective))
+    duals_equal = all(
+        _np.array_equal(pm.duals[k], psh.duals[k]) for k in pm.duals)
+
+    platform = _jax.devices()[0].platform
+    real_mesh = platform != "cpu"
+    gates = {
+        "both_converged": bool(stab.converged and ctrl.converged),
+        "stabilized_rounds_cut_ge_40pct": rounds_cut >= 0.40,
+        "sharded_parity_exact": bool(duals_equal) and parity_rel < 1e-9,
+    }
+    if real_mesh:
+        gates["sharded_amortized_throughput_ge_monolithic"] = \
+            shard_wps >= mono_wps
+    ok = all(gates.values())
+    log(f"bench[portfolio_scale]: {sites} sites, gap {gap_tol:g}: "
+        f"stabilized {stab.outer_rounds} rounds ({t_stab:.1f}s) vs "
+        f"control {ctrl.outer_rounds} ({t_ctrl:.1f}s) = "
+        f"{rounds_cut:.0%} cut (gate >= 40%); sharded x{n_shards} "
+        f"round {shard_round_s:.2f}s vs monolithic {mono_round_s:.2f}s "
+        f"({shard_wps:.1f} vs {mono_wps:.1f} windows/s, real-mesh "
+        f"gated); parity rel {parity_rel:.2e} duals_equal "
+        f"{duals_equal}; gates {'OK' if ok else 'FAIL: ' + str(gates)}")
+    if not ok:
+        raise SystemExit(12)
+    return {
+        "sites": sites, "hours": hours, "window": window,
+        "gap_tol": gap_tol, "export_cap_kw": round(cap, 1),
+        "stabilized": {"outer_rounds": stab.outer_rounds,
+                       "gap_rel": stab.gap_rel,
+                       "wall_s": round(t_stab, 2),
+                       "regimes": regimes},
+        "control": {"outer_rounds": ctrl.outer_rounds,
+                    "gap_rel": ctrl.gap_rel,
+                    "wall_s": round(t_ctrl, 2)},
+        "rounds_cut": round(rounds_cut, 3),
+        "sharded": {"shards": n_shards,
+                    "rounds_measured": shard_rounds,
+                    "round_wall_s_steady": round(shard_round_s, 3),
+                    "windows_per_s": round(shard_wps, 2),
+                    "monolithic_round_wall_s_steady":
+                        round(mono_round_s, 3),
+                    "monolithic_windows_per_s": round(mono_wps, 2),
+                    "throughput_x": round(shard_wps / mono_wps, 2)},
+        "parity_cpu_16_sites": {"rel_objective": parity_rel,
+                                "duals_equal": bool(duals_equal)},
+        "stab_rounds": [{k: r[k] for k in
+                         ("round", "regime", "step", "gap_rel",
+                          "wall_s")} for r in stab.rounds],
+        "ctrl_rounds": [{k: r[k] for k in
+                         ("round", "regime", "step", "gap_rel",
+                          "wall_s")} for r in ctrl.rounds],
         "gates": gates,
         "gated_on_real_mesh": real_mesh,
     }

@@ -319,7 +319,8 @@ class SolutionMemory:
                       "hits_near": 0, "hits_predicted": 0,
                       "hits_dual": 0, "misses": 0,
                       "substituted": 0, "stale_seed_faults": 0,
-                      "invalidated": 0, "imported": 0}
+                      "invalidated": 0, "imported": 0,
+                      "imported_hints": 0}
         # dual-iterate side table: hint key -> latest converged iterate
         # (the portfolio dual loop's reseeding store; see module doc)
         self._hints: "OrderedDict[tuple, SeedEntry]" = OrderedDict()
@@ -547,21 +548,67 @@ class SolutionMemory:
                 self._evict_lru()
         return n
 
+    def export_hints(self, max_hints: int = 256) -> List[Tuple]:
+        """Serializable snapshot of the most-recent ``dual_iterate``
+        hint-table entries (portfolio outer-loop iterates keyed by
+        ``(tag, site, window)``).  Without these in the fleet handoff, a
+        failover or re-routed portfolio shard restarts its sites COLD
+        mid-dual-loop — the hint is the round-k converged iterate, so
+        the inheritor that imports it reseeds round k+1 exactly as the
+        dead replica would have."""
+        with self._lock:
+            items = list(self._hints.items())[-int(max_hints):]
+            return [(key, {"x": np.array(e.x), "y": np.array(e.y),
+                           "obj": e.obj})
+                    for key, e in items]
+
+    def import_hints(self, payload) -> int:
+        """Install another replica's exported hint entries (skipping
+        malformed ones; a key already present keeps the LOCAL iterate —
+        it is at least as recent).  Returns the number installed."""
+        n = 0
+        for key, f in payload or []:
+            try:
+                key = tuple(key)
+                x = np.asarray(f["x"], np.float64)
+                y = np.asarray(f["y"], np.float64)
+                obj = float(f["obj"])
+                with self._lock:
+                    # an unhashable key (nested list from a foreign
+                    # serialization) raises HERE — skip it, keep going
+                    if key in self._hints:
+                        continue
+                    self._hints[key] = SeedEntry(
+                        x=x, y=y, obj=obj, feature=np.zeros(0), tag=(),
+                        exact=b"", quant=b"")
+                    while len(self._hints) > self.max_entries:
+                        self._hints.popitem(last=False)
+                    self.stats["imported_hints"] += 1
+                    n += 1
+            except (KeyError, TypeError, ValueError):
+                continue
+        return n
+
     def export_payload(self, max_entries: int = 128,
-                       max_models: int = 16) -> Dict:
+                       max_models: int = 16,
+                       max_hints: int = 256) -> Dict:
         """The full fleet-handoff payload: recent entries PLUS the
-        learned seed models (ops/seedpredict.py), so the inheriting
-        replica both substitutes byte-exact repeats and predicts for
-        structures it never solved."""
+        learned seed models (ops/seedpredict.py) PLUS the bounded
+        ``dual_iterate`` hint table, so the inheriting replica
+        substitutes byte-exact repeats, predicts for structures it
+        never solved, and stays warm mid-portfolio-dual-loop."""
         return {"entries": self.export_entries(max_entries),
-                "models": self.predictor.export_models(max_models)}
+                "models": self.predictor.export_models(max_models),
+                "hints": self.export_hints(max_hints)}
 
     def import_payload(self, payload, exact_only: bool = True) -> int:
         """Install an exported payload — the ``export_payload`` dict or
-        a bare ``export_entries`` list (older replicas).  Returns the
-        number of ENTRIES installed (models are best-effort extras)."""
+        a bare ``export_entries`` list (older replicas; a dict without
+        ``"hints"`` is likewise legal).  Returns the number of ENTRIES
+        installed (models and hints are best-effort extras)."""
         if isinstance(payload, dict):
             self.predictor.import_models(payload.get("models"))
+            self.import_hints(payload.get("hints"))
             payload = payload.get("entries") or []
         return self.import_entries(payload, exact_only=exact_only)
 

@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -58,11 +59,12 @@ import scipy.sparse as sp
 
 from ..ops import certify
 from ..scenario.scenario import SolverCache, run_dispatch
+from ..telemetry import trace as telemetry_trace
 from ..utils import faultinject
 from ..utils.errors import (ParameterError, PortfolioInfeasibleError,
                             RequestFailedError, TellUser)
 from .site import PortfolioSiteScenario
-from .spec import CouplingRows, PortfolioSpec
+from .spec import CouplingRows, PortfolioSpec, stabilization_enabled
 
 
 @dataclasses.dataclass(eq=False)
@@ -103,6 +105,8 @@ class PortfolioResult:
         self.converged: bool = False
         self.outer_rounds: int = 0
         self.dual_rescales: int = 0
+        self.stabilized: bool = True
+        self.shard_plan: Optional[List[List[str]]] = None
         self.objective_cx: float = float("nan")
         self.objective_total: float = float("nan")
         self.demand_charge_cost: float = 0.0
@@ -129,6 +133,8 @@ class PortfolioResult:
             "converged": bool(self.converged),
             "outer_rounds": int(self.outer_rounds),
             "dual_rescales": int(self.dual_rescales),
+            "stabilized": bool(self.stabilized),
+            "shards": (len(self.shard_plan) if self.shard_plan else 1),
             "gap_rel": (None if not np.isfinite(self.gap_rel)
                         else float(self.gap_rel)),
             "objective_cx": float(self.objective_cx),
@@ -148,6 +154,8 @@ class PortfolioResult:
             "converged": bool(self.converged),
             "outer_rounds": int(self.outer_rounds),
             "dual_rescales": int(self.dual_rescales),
+            "stabilized": bool(self.stabilized),
+            "shards": (len(self.shard_plan) if self.shard_plan else 1),
             "objective_cx": float(self.objective_cx),
             "objective_total": float(self.objective_total),
             "demand_charge_cost": float(self.demand_charge_cost),
@@ -415,7 +423,8 @@ def solve_portfolio(spec: PortfolioSpec, *, backend: str = "jax",
                     solver_opts=None, solver_cache=None,
                     supervisor=None, breaker_board=None,
                     request_id: Optional[str] = None,
-                    degraded: bool = False) -> PortfolioResult:
+                    degraded: bool = False, fleet=None,
+                    on_round=None) -> PortfolioResult:
     """Solve one coupled portfolio (see module docstring).
 
     ``solver_cache`` (a :class:`SolverCache`) injects a long-lived
@@ -425,7 +434,20 @@ def solve_portfolio(spec: PortfolioSpec, *, backend: str = "jax",
     round-over-round program set fixed even when exact substitution
     shrinks a batch).  ``degraded`` runs the load-shed tier: screening
     solver options, certification disabled thread-locally, the answer
-    explicitly marked and NEVER certificate-stamped."""
+    explicitly marked and NEVER certificate-stamped.
+
+    ``fleet`` (a :class:`~dervet_tpu.service.router.FleetRouter`)
+    shards each dual round's member batch across the fleet's replicas
+    (``spec.shards`` shards, default one per replica): shard payloads
+    ride the replica transport with the dual-price vector, the sticky
+    per-shard affinity keeps each shard on the replica whose compiled
+    programs and ``dual_iterate`` hints are warm for it, and a dead
+    replica's shard re-routes through the exactly-once failover.
+    Without a fleet, ``spec.shards > 1`` runs the same shard plan
+    in-process (concurrent dispatches, per-shard caches).  For a FIXED
+    shard plan the per-site columns and costs are identical across all
+    three executors.  ``on_round(k, result)`` fires after each round's
+    record lands (smoke/bench hooks)."""
     spec.validate()
     t_start = time.monotonic()
     scens = build_site_scenarios(spec, request_id)
@@ -453,6 +475,53 @@ def solve_portfolio(spec: PortfolioSpec, *, backend: str = "jax",
     cache = solver_cache if solver_cache is not None else \
         SolverCache(pad_grid=(backend != "cpu"), warm_start=True)
 
+    # ---- shard plan + round executor --------------------------------
+    # (shard.py; the degraded tier stays monolithic — its screening
+    # round is cheap by construction and the thread-local cert override
+    # is simplest to reason about on one dispatch thread)
+    from .shard import (FleetShardExecutor, LocalShardExecutor,
+                        MonolithicExecutor, plan_shards)
+    if fleet is not None and not degraded:
+        n_shards = (int(spec.shards) if spec.shards is not None
+                    else len(fleet.replicas))
+        plan = plan_shards(scens, n_shards)
+        # anonymous solves get a UNIQUE portfolio id: shard rids embed
+        # it, and the router's exactly-once memo refuses a reused rid —
+        # two back-to-back anonymous solves on one router must not
+        # collide on "pf.s00.r000"
+        import uuid as _uuid
+        executor = FleetShardExecutor(
+            {str(k): v for k, v in spec.members.items()}, plan, fleet,
+            backend=backend, solver_opts=opts,
+            portfolio_id=(request_id
+                          or f"pf-{_uuid.uuid4().hex[:10]}"))
+    else:
+        n_shards = 1 if degraded else spec.effective_shards(len(scens))
+        if n_shards > 1 and backend != "cpu" and \
+                os.environ.get("DERVET_TPU_ELASTIC", "1").strip() == "0":
+            import jax as _jax
+            if len(_jax.devices()) > 1:
+                # the legacy serial scheduler drives mesh-wide
+                # shard_map programs, which must not run concurrently —
+                # clamp rather than abort the whole process
+                TellUser.warning(
+                    "portfolio: DERVET_TPU_ELASTIC=0 forces mesh-wide "
+                    "shard_map dispatches that cannot run concurrently "
+                    f"— ignoring shards={n_shards}, running the round "
+                    "monolithically")
+                n_shards = 1
+        plan = plan_shards(scens, n_shards)
+        if len(plan) > 1:
+            executor = LocalShardExecutor(
+                scens, plan, backend=backend, solver_opts=opts,
+                supervisor=supervisor, breaker_board=breaker_board,
+                cert_ctx=cert_ctx, memory=cache.memory)
+        else:
+            executor = MonolithicExecutor(
+                scens, backend=backend, solver_opts=opts,
+                solver_cache=cache, supervisor=supervisor,
+                breaker_board=breaker_board, cert_ctx=cert_ctx)
+
     duals = rows.zero_duals()
     duals_best = rows.zero_duals()      # the prices behind best_dual
     step = 1.0
@@ -460,9 +529,24 @@ def solve_portfolio(spec: PortfolioSpec, *, backend: str = "jax",
     prev_gap_abs: Optional[float] = None
     prev_master_feasible = False
     dual_rescales = 0
+    # stabilized Dantzig-Wolfe master (in-out / proximal-level): the
+    # separation point blends the STABILITY CENTER (duals_best, the
+    # prices behind the best dual bound) toward the master marginals by
+    # ``alpha``; a level-set test on the next round's dual bound
+    # classifies serious (lengthen alpha) vs null (contract alpha)
+    # steps.  Kill switch DERVET_TPU_PORTFOLIO_STABILIZE=0 (or
+    # spec.master_stabilization=False) skips every line of this state
+    # and runs the legacy three-regime step bit for bit.
+    stabilize = stabilization_enabled(spec)
+    alpha = 0.5                         # in-out blend coefficient
+    alpha_min, alpha_max = 0.1, 1.0
+    level_frac = 0.3                    # level set: best + frac * gap
+    level_prev: Optional[float] = None
+    nulls = 0                           # consecutive null steps
     columns: Dict[str, List[Column]] = {k: [] for k in scens}
     result = PortfolioResult()
     result.request_id = request_id
+    result.stabilized = stabilize
     result.fidelity = "degraded" if degraded else "certified"
     if degraded:
         result.resubmit_hint = (
@@ -470,8 +554,10 @@ def solve_portfolio(spec: PortfolioSpec, *, backend: str = "jax",
             "load): screening-tier inner solves, no certificates — "
             "resubmit with a higher priority for a certified answer")
     result.index = index
+    result.shard_plan = plan
     master: Optional[MasterSolution] = None
     ledger = None
+    last_rd = None
     scen_list = list(scens.values())
 
     for k in range(spec.max_outer):
@@ -481,24 +567,20 @@ def solve_portfolio(spec: PortfolioSpec, *, backend: str = "jax",
             # final blend's column weights stay aligned
             _trim_columns(columns, spec.max_columns - 1)
         price = rows.price(duals)
-        for s in scen_list:
-            s.coupling_price = price
         t0 = time.monotonic()
-        with cert_ctx():
-            run_dispatch(scen_list, backend=backend, solver_opts=opts,
-                         supervisor=supervisor, solver_cache=cache,
-                         breaker_board=breaker_board)
+        rd = executor.dispatch_round(price, k, request_id=request_id)
         round_wall = time.monotonic() - t0
-        for key, s in scens.items():
-            if s.quarantine is not None:
+        last_rd = rd
+        for key, oc in rd.outcomes.items():
+            if oc.quarantine is not None:
                 raise RequestFailedError(
-                    {key: s.quarantine["reason"]})
-        ledger = scen_list[0].solve_metadata.get("solve_ledger")
+                    {key: oc.quarantine["reason"]})
+        ledger = rd.ledger
 
         # dual bound (Lagrangian): sum of shifted site minima minus
         # lam'b — EXACT with cpu inner solves, inner-tolerance-honest
         # with f32 PDHG (the certificate records which)
-        shifted_sum = sum(s.shifted_cost_cx() for s in scen_list)
+        shifted_sum = sum(oc.shifted for oc in rd.outcomes.values())
         dual_bound_k = shifted_sum - rows.dual_rhs_term(duals)
         regressed = False
         if k > 0 and prev_master_feasible and np.isfinite(best_dual):
@@ -522,46 +604,68 @@ def solve_portfolio(spec: PortfolioSpec, *, backend: str = "jax",
                 # an anchor).
                 regressed = True
                 dual_rescales += 1
-                step = max(0.5 * step, 0.125)
+                if stabilize:
+                    # a corrupted probe is the hardest null step there
+                    # is: contract toward the stability center
+                    alpha = max(0.5 * alpha, alpha_min)
+                else:
+                    step = max(0.5 * step, 0.125)
                 TellUser.warning(
                     f"portfolio: dual bound regressed at outer round "
                     f"{k} ({dual_bound_k:.6g} vs best {best_dual:.6g})"
-                    f" — dual step rescaled to {step:g}")
+                    f" — dual step rescaled to "
+                    f"{alpha if stabilize else step:g}")
         if dual_bound_k > best_dual:
             best_dual = dual_bound_k
             duals_best = {kk: np.array(v) for kk, v in duals.items()}
 
-        for key, s in scens.items():
+        for key, oc in rd.outcomes.items():
             columns[key].append(Column(
-                phi=s.true_cost_cx(),
-                activity=s.activity_series(),
-                solution={n: np.array(a) for n, a in
-                          s._solution.items()},
+                phi=oc.phi,
+                activity=oc.activity,
+                solution=oc.solution,
                 round_idx=k))
-        master = _solve_master(columns, rows, spec, price_cap)
+        # telemetry: one master_solve child per round under the
+        # portfolio_dual_loop span (gap/slack/regime attrs) — `dervet-
+        # tpu trace` shows where a slow portfolio round went
+        mspan = telemetry_trace.start_span(
+            "master_solve", rid=request_id,
+            attrs={"round": k, "stabilized": stabilize,
+                   "columns": sum(len(c) for c in columns.values())})
+        try:
+            master = _solve_master(columns, rows, spec, price_cap)
+        except BaseException as e:
+            mspan.end(error=e)
+            raise
         gap_abs = max(master.objective - best_dual, 0.0)
         gap_rel = gap_abs / (1.0 + abs(master.objective)
                              + abs(best_dual))
         prev_gap_abs = gap_abs
+        mspan.set_attrs({"gap_rel": float(gap_rel),
+                         "slack_rel_max": float(master.slack_rel_max),
+                         "primal": float(master.objective),
+                         "dual_bound": float(dual_bound_k)})
 
-        led_tot = (ledger or {}).get("totals") or {}
-        warm = (ledger or {}).get("warm_start") or {}
+        summ = rd.summary
         result.rounds.append({
             "round": k,
             "wall_s": round(round_wall, 3),
-            "iters_p50": ((ledger or {}).get("iters") or {}).get("p50"),
-            "iters_p50_seeded": warm.get("iters_p50_seeded"),
-            "iters_p50_cold": warm.get("iters_p50_cold"),
-            "seeded": int(warm.get("seeded", 0)),
-            "dual_iterate": int(warm.get("dual_iterate", 0)),
-            "substituted": int(warm.get("substituted", 0)),
-            "compile_events": int(led_tot.get("compile_events", 0)),
-            "windows": int(led_tot.get("windows", 0)),
+            "iters_p50": summ.get("iters_p50"),
+            "iters_p50_seeded": summ.get("iters_p50_seeded"),
+            "iters_p50_cold": summ.get("iters_p50_cold"),
+            "seeded": int(summ.get("seeded", 0)),
+            "dual_iterate": int(summ.get("dual_iterate", 0)),
+            "substituted": int(summ.get("substituted", 0)),
+            "compile_events": int(summ.get("compile_events", 0)),
+            "windows": int(summ.get("windows", 0)),
+            "shards": len(plan),
+            "shard_detail": rd.shard_records,
             "dual_bound": round(float(dual_bound_k), 6),
             "primal": round(float(master.objective), 6),
             "gap_rel": round(float(gap_rel), 9),
             "slack_rel_max": round(float(master.slack_rel_max), 9),
-            "step": step,
+            "step": (alpha if stabilize else step),
+            "regime": None,     # filled by this round's dual update
             "regressed": regressed,
         })
         TellUser.info(
@@ -570,10 +674,14 @@ def solve_portfolio(spec: PortfolioSpec, *, backend: str = "jax",
             f"slack {master.slack_rel_max:.2e}, "
             f"iters p50 {result.rounds[-1]['iters_p50']}, "
             f"{result.rounds[-1]['compile_events']} compile(s)")
+        if on_round is not None:
+            on_round(k, result)
         if gap_rel <= spec.gap_tol and \
                 master.slack_rel_max <= spec.feas_tol:
             result.converged = True
             result.outer_rounds = k + 1
+            result.rounds[-1]["regime"] = "converged"
+            mspan.set_attr("regime", "converged").end()
             break
         if master.slack_rel_max > spec.feas_tol and k >= 2:
             # runtime infeasibility: the elastic slack persists while
@@ -584,13 +692,15 @@ def solve_portfolio(spec: PortfolioSpec, *, backend: str = "jax",
             at_cap = bool(w) and duals.get(w.get("kind")) is not None \
                 and float(np.max(duals[w["kind"]])) >= 0.99 * price_cap
             if at_cap and master.slack_rel_max > 0.9 * prev_slack:
-                raise PortfolioInfeasibleError(
+                err = PortfolioInfeasibleError(
                     "portfolio coupling rows proved unsatisfiable at "
                     f"runtime: {w.get('kind')} row t={w.get('t')} "
                     f"keeps {w.get('slack_kw', 0.0):.1f} kW of elastic "
                     f"slack with its dual price at the "
                     f"{price_cap:g} cap",
                     violations=[{**w, "runtime": True}])
+                mspan.end(error=err)
+                raise err
         # projected dual-ascent step toward the master's marginals,
         # three regimes:
         #  * elastic slack active (or the FIRST feasible master): JUMP
@@ -613,20 +723,63 @@ def solve_portfolio(spec: PortfolioSpec, *, backend: str = "jax",
         target = master.duals
         new_duals = {}
         if regressed:
+            regime = "regressed"
+            a = alpha if stabilize else step
             for kind in rows.kinds:
-                lam = duals_best[kind] + step * (target[kind]
-                                                 - duals_best[kind])
+                lam = duals_best[kind] + a * (target[kind]
+                                              - duals_best[kind])
                 new_duals[kind] = np.clip(lam, 0.0, price_cap)
         elif not (prev_master_feasible and was_feasible):
+            regime = "jump"
             for kind in rows.kinds:
                 new_duals[kind] = np.clip(target[kind], 0.0, price_cap)
+        elif stabilize:
+            # in-out / proximal-level step.  Serious/null test: did this
+            # round's probe (the dual bound at the CURRENT prices) reach
+            # the level set last round carved between the best bound and
+            # the master objective?  Serious — the in-out point is
+            # paying — lengthen alpha toward the master marginals; null
+            # — a degenerate-vertex excursion — contract toward the
+            # stability center.  The separation point always leaves the
+            # CENTER (duals_best), never the last probe, so vertex
+            # oscillation cannot compound across rounds; and as the gap
+            # closes both the center and the marginals pin to lam*, the
+            # round-over-round price delta vanishes, and the
+            # dual_iterate warm seeds keep their food supply.
+            serious = level_prev is None or dual_bound_k >= level_prev
+            if serious:
+                alpha = min(alpha_max, 1.5 * alpha)
+                nulls = 0
+                regime = "in_out_serious"
+            else:
+                alpha = max(alpha_min, 0.5 * alpha)
+                nulls += 1
+                regime = "in_out_null"
+            level_prev = best_dual + level_frac * gap_abs
+            a_eff = alpha
+            if nulls >= 2:
+                # stall escape: two consecutive null probes mean the
+                # in-out point stopped teaching the master anything —
+                # probe the PURE marginals once (the exact-CG
+                # separation point), which is what preserves finite
+                # convergence on exact toy problems and re-arms the
+                # level test on a genuinely new vertex
+                a_eff = 1.0
+                nulls = 0
+                regime = "in_out_exact"
+            for kind in rows.kinds:
+                lam = duals_best[kind] + a_eff * (target[kind]
+                                                  - duals_best[kind])
+                new_duals[kind] = np.clip(lam, 0.0, price_cap)
         else:
             if gap_rel <= 10.0 * spec.gap_tol:
                 n_close = sum(1 for r in result.rounds
                               if r["gap_rel"] <= 10.0 * spec.gap_tol)
                 step = max(2.0 / (2.0 + n_close), 0.02)
+                regime = "harmonic"
             else:
                 step = min(0.35, step * 1.6)
+                regime = "capped"
             for kind in rows.kinds:
                 lam = duals[kind] + step * (target[kind] - duals[kind])
                 new_duals[kind] = np.clip(lam, 0.0, price_cap)
@@ -643,6 +796,8 @@ def solve_portfolio(spec: PortfolioSpec, *, backend: str = "jax",
             if tot > rows.demand_charge > 0:
                 new_duals["demand_charge"] *= rows.demand_charge / tot
         duals = new_duals
+        result.rounds[-1]["regime"] = regime
+        mspan.set_attr("regime", regime).end()
     else:
         result.outer_rounds = spec.max_outer
 
@@ -693,8 +848,8 @@ def solve_portfolio(spec: PortfolioSpec, *, backend: str = "jax",
                       "lhs": rows.activity(kind, A_blend, M=master.M),
                       "rhs": rows.rhs[kind]}
                      for kind in rows.kinds]
-    cert_by_site = {k: getattr(s, "certification", None)
-                    for k, s in scens.items()}
+    cert_by_site = {k: oc.certification
+                    for k, oc in last_rd.outcomes.items()}
     n_windows = sum(len(s.windows) for s in scen_list)
     n_cert = sum(int(c.get("certified", 0))
                  + int(c.get("certified_loose", 0))
@@ -710,9 +865,9 @@ def solve_portfolio(spec: PortfolioSpec, *, backend: str = "jax",
 
     from ..io.summary import run_health_report
     health = run_health_report(
-        {k: getattr(s, "health", {}) for k, s in scens.items()},
-        {k: s.quarantine for k, s in scens.items()
-         if s.quarantine is not None},
+        {k: (oc.health or {}) for k, oc in last_rd.outcomes.items()},
+        {k: oc.quarantine for k, oc in last_rd.outcomes.items()
+         if oc.quarantine is not None},
         certification_by_case=cert_by_site)
     health["fidelity"] = result.fidelity
     health["portfolio"] = result.portfolio_section()
@@ -837,8 +992,9 @@ def validate_portfolio_section(section: Dict) -> Dict:
     if not isinstance(section, dict):
         raise ValueError(
             f"portfolio section must be a dict, got {type(section)}")
-    for k in ("converged", "outer_rounds", "dual_rescales", "gap_rel",
-              "objective_cx", "sites", "rounds", "certification"):
+    for k in ("converged", "outer_rounds", "dual_rescales", "stabilized",
+              "shards", "gap_rel", "objective_cx", "sites", "rounds",
+              "certification"):
         if k not in section:
             raise ValueError(f"portfolio section missing {k!r}")
     if not isinstance(section["rounds"], list) or not section["rounds"]:
@@ -846,8 +1002,8 @@ def validate_portfolio_section(section: Dict) -> Dict:
                          "list")
     for i, r in enumerate(section["rounds"]):
         for k in ("round", "iters_p50", "seeded", "dual_iterate",
-                  "substituted", "compile_events", "windows",
-                  "gap_rel", "slack_rel_max", "step"):
+                  "substituted", "compile_events", "windows", "shards",
+                  "regime", "gap_rel", "slack_rel_max", "step"):
             if k not in r:
                 raise ValueError(
                     f"portfolio section rounds[{i}] missing {k!r}")

@@ -39,11 +39,30 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import os
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..utils.errors import ParameterError
+
+# kill switch for the stabilized Dantzig-Wolfe master: =0 restores the
+# PR-13 three-regime step (jump / 0.35-capped / harmonic decay) bit for
+# bit, spec.master_stabilization notwithstanding
+STABILIZE_ENV = "DERVET_TPU_PORTFOLIO_STABILIZE"
+# shard-count override for the fleet-sharded inner rounds (solo callers;
+# the spec field wins when set)
+SHARDS_ENV = "DERVET_TPU_PORTFOLIO_SHARDS"
+
+
+def stabilization_enabled(spec: "PortfolioSpec") -> bool:
+    """The effective master-stabilization switch: the spec default is
+    ON; the env kill switch forces the legacy loop regardless (read per
+    call so an operator can flip it mid-incident)."""
+    if os.environ.get(STABILIZE_ENV, "1").strip().lower() in (
+            "0", "false", "off"):
+        return False
+    return bool(spec.master_stabilization)
 
 # the kinds, in canonical order (dual vectors stack in this order for
 # fault injection / serialization)
@@ -95,7 +114,24 @@ class PortfolioSpec:
     price scale every site response is already extremal, and handing
     PDHG penalty-scale prices just burns inner iterations.
     ``max_columns`` bounds the per-site column pool the primal-recovery
-    master blends over."""
+    master blends over.
+
+    ``master_stabilization`` (default ON) runs the dual update as an
+    in-out / proximal-level stabilized step: the separation point blends
+    the STABILITY CENTER (the prices behind the best dual bound) toward
+    the restricted master's marginals, with a level-set test on the dual
+    bound deciding serious vs null steps — degenerate-vertex dual
+    oscillation stops burning outer rounds (the column-generation tail
+    the harmonic-decay step only papered over).  ``False`` — or the
+    ``DERVET_TPU_PORTFOLIO_STABILIZE=0`` kill switch — restores the
+    PR-13 loop bit for bit.
+
+    ``shards`` partitions one dual round's member batch into N
+    structure-aware shards dispatched concurrently (in-process across
+    the elastic mesh, or across fleet replicas when ``solve_portfolio``
+    is handed a ``fleet`` router).  ``None``/1 keeps today's one-
+    dispatch round bit for bit; the ``DERVET_TPU_PORTFOLIO_SHARDS`` env
+    var overrides a ``None`` for solo callers."""
 
     members: Dict[str, object]
     export_cap_kw: Optional[object] = None
@@ -107,6 +143,8 @@ class PortfolioSpec:
     max_outer: int = 12
     price_cap: Optional[float] = None
     max_columns: int = 20
+    master_stabilization: bool = True
+    shards: Optional[int] = None
 
     def validate(self) -> "PortfolioSpec":
         if not isinstance(self.members, dict) or not self.members:
@@ -135,7 +173,21 @@ class PortfolioSpec:
             raise ParameterError("portfolio: price_cap must be positive")
         if self.max_columns < 2:
             raise ParameterError("portfolio: max_columns must be >= 2")
+        if self.shards is not None and int(self.shards) < 1:
+            raise ParameterError("portfolio: shards must be >= 1")
         return self
+
+    def effective_shards(self, n_sites: int) -> int:
+        """The shard count one dual round actually runs with: the spec
+        field, else the env override, else 1 (monolithic) — always
+        clamped to the site count (an empty shard is never planned)."""
+        n = self.shards
+        if n is None:
+            try:
+                n = int(os.environ.get(SHARDS_ENV, "1"))
+            except ValueError:
+                n = 1
+        return max(1, min(int(n), int(n_sites)))
 
     # ------------------------------------------------------------------
     def coupling_profiles(self, T: int) -> Dict[str, np.ndarray]:
@@ -176,6 +228,8 @@ class PortfolioSpec:
             "price_cap": (None if self.price_cap is None
                           else float(self.price_cap)),
             "max_columns": int(self.max_columns),
+            "master_stabilization": bool(self.master_stabilization),
+            "shards": (None if self.shards is None else int(self.shards)),
         }
 
     def fingerprint_knobs(self) -> str:

@@ -107,7 +107,8 @@ class ReplicaHandle:
     def submit(self, cases, rid: str, *, priority: int = 0,
                deadline_epoch: Optional[float] = None,
                payload: Optional[bytes] = None,
-               trace_ctx: Optional[Dict] = None) -> None:
+               trace_ctx: Optional[Dict] = None,
+               extra: Optional[Dict] = None) -> None:
         """Hand one request to the replica.  May raise the replica's
         typed admission errors synchronously (local transport); spool
         transport never raises here — outcomes arrive via :meth:`poll`.
@@ -116,7 +117,12 @@ class ReplicaHandle:
         (``{"trace_id", "span_id"}``): it rides the transport (spool
         pickle payload / local submit kwarg) so the replica-side span
         tree parents under the router's — one stitched trace per
-        request across processes."""
+        request across processes.
+
+        ``extra`` carries request-kind extensions through the same
+        transport — today the ``portfolio_shard`` payload (one shard of
+        a fleet-sharded portfolio dual round: site cases + dual-price
+        vector; see ``dervet_tpu.portfolio.shard``)."""
         raise NotImplementedError
 
     def poll(self, rid: str) -> Optional[Tuple[str, object]]:
@@ -213,12 +219,16 @@ class SpoolReplica(ReplicaHandle):
     @staticmethod
     def encode_payload(cases, *, priority: int = 0,
                        deadline_epoch: Optional[float] = None,
-                       trace: Optional[Dict] = None) -> bytes:
+                       trace: Optional[Dict] = None,
+                       extra: Optional[Dict] = None) -> bytes:
         # "trace" is the router's telemetry context: the replica's
-        # submit_pickle hands it to ScenarioService.submit as trace_ctx
+        # submit_pickle hands it to ScenarioService.submit as trace_ctx;
+        # "extra" merges kind extensions (the portfolio_shard payload)
+        # into the same transport record
         return pickle.dumps({"cases": cases, "priority": int(priority),
                              "deadline_epoch": deadline_epoch,
-                             **({"trace": trace} if trace else {})},
+                             **({"trace": trace} if trace else {}),
+                             **(extra or {})},
                             protocol=pickle.HIGHEST_PROTOCOL)
 
     def _fname(self, rid: str) -> str:
@@ -227,11 +237,12 @@ class SpoolReplica(ReplicaHandle):
     def submit(self, cases, rid: str, *, priority: int = 0,
                deadline_epoch: Optional[float] = None,
                payload: Optional[bytes] = None,
-               trace_ctx: Optional[Dict] = None) -> None:
+               trace_ctx: Optional[Dict] = None,
+               extra: Optional[Dict] = None) -> None:
         if payload is None:
             payload = self.encode_payload(cases, priority=priority,
                                           deadline_epoch=deadline_epoch,
-                                          trace=trace_ctx)
+                                          trace=trace_ctx, extra=extra)
         # dot-prefixed tmp + rename: the serve scan globs non-dot names,
         # so a half-written payload can never be admitted
         final = self.incoming / self._fname(rid)
@@ -409,13 +420,20 @@ class LocalReplica(ReplicaHandle):
     def submit(self, cases, rid: str, *, priority: int = 0,
                deadline_epoch: Optional[float] = None,
                payload: Optional[bytes] = None,
-               trace_ctx: Optional[Dict] = None) -> None:
+               trace_ctx: Optional[Dict] = None,
+               extra: Optional[Dict] = None) -> None:
         deadline_s = None
         if deadline_epoch is not None:
             deadline_s = max(0.0, deadline_epoch - time.time())
         # the rid rides through unchanged: each LocalReplica wraps its
         # OWN service, so ids cannot cross-wire between replicas, and
         # artifact names stay identical to a single-replica run
+        if extra and extra.get("portfolio_shard") is not None:
+            self._futures[rid] = self.service.submit_portfolio_shard(
+                extra["portfolio_shard"], request_id=rid,
+                priority=priority, deadline_s=deadline_s,
+                trace_ctx=trace_ctx)
+            return
         self._futures[rid] = self.service.submit(
             cases, request_id=rid, priority=priority,
             deadline_s=deadline_s, trace_ctx=trace_ctx)

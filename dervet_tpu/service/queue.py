@@ -51,12 +51,16 @@ class QueuedRequest:
     and ``design_state`` carries the screening results to frontier
     assembly at delivery), and ``"portfolio"`` (coupled-fleet
     co-optimization — ``portfolio_spec`` carries the member cases +
-    coupling constraints; the dual loop runs in its own round)."""
+    coupling constraints; the dual loop runs in its own round).  A
+    ``"portfolio_shard"`` request is one shard of ANOTHER node's dual
+    round (``shard_payload``: site cases + the round's dual-price
+    vector), dispatched against this replica's persistent caches — see
+    ``dervet_tpu.portfolio.shard``."""
 
     __slots__ = ("request_id", "cases", "priority", "deadline", "future",
                  "seq", "t_submit", "fingerprint", "kind", "design_case",
                  "design_spec", "design_state", "portfolio_spec",
-                 "span", "trace_ctx")
+                 "shard_payload", "span", "trace_ctx")
 
     def __init__(self, request_id: str, cases: Dict, priority: int = 0,
                  deadline_s: Optional[float] = None, seq: int = 0,
@@ -77,6 +81,7 @@ class QueuedRequest:
         self.design_spec = None
         self.design_state = None
         self.portfolio_spec = None
+        self.shard_payload = None
         # telemetry (dervet_tpu/telemetry): the request's root span on
         # THIS process (ends when the future resolves) and the upstream
         # trace context it was propagated under (fleet transport)

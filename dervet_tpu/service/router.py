@@ -121,13 +121,16 @@ class _Pending:
     __slots__ = ("rid", "fp", "cases", "payload", "priority",
                  "deadline_epoch", "deadline_s", "future", "routes",
                  "t_submit", "answered", "answered_at", "recovered",
-                 "unplaced_since", "span")
+                 "unplaced_since", "span", "extra")
 
     def __init__(self, rid, fp, cases, priority, deadline_s):
         self.rid = rid
         self.fp = fp
         self.cases = cases
         self.payload: Optional[bytes] = None
+        # request-kind extension riding the transport (the
+        # portfolio_shard payload); also merged into spool pickles
+        self.extra: Optional[Dict] = None
         self.priority = int(priority)
         self.deadline_s = deadline_s
         self.deadline_epoch = (None if deadline_s is None
@@ -313,12 +316,20 @@ class FleetRouter:
 
     # -- admission / routing --------------------------------------------
     def submit(self, cases, *, request_id=None, priority: int = 0,
-               deadline_s: Optional[float] = None) -> Future:
+               deadline_s: Optional[float] = None,
+               affinity_key: Optional[str] = None,
+               extra: Optional[Dict] = None) -> Future:
         """Route one request; returns the future its
         :class:`RoutedResult` (or typed error) is delivered through.
         Raises :class:`FleetUnavailableError` (a ``QueueFullError``,
         ``retry_after_s`` = the smallest hint any replica offered) when
-        no replica can take it right now."""
+        no replica can take it right now.
+
+        ``affinity_key`` overrides the structure-fingerprint affinity
+        key (the fleet-sharded portfolio rounds key each SHARD's
+        stickiness separately — one portfolio's structure-identical
+        shards must spread over replicas, then stay put); ``extra``
+        rides the replica transport as a request-kind extension."""
         with self._lock:
             if self._closed:
                 raise ServiceClosedError(
@@ -340,8 +351,11 @@ class FleetRouter:
                 cases = dict(enumerate(cases))
             if not cases:
                 raise ValueError("a request needs at least one case")
-            p = _Pending(rid, structure_fingerprint(cases), cases,
-                         priority, deadline_s)
+            p = _Pending(rid,
+                         (str(affinity_key) if affinity_key is not None
+                          else structure_fingerprint(cases)),
+                         cases, priority, deadline_s)
+            p.extra = extra
             # telemetry root span: the trace id derives from the rid, so
             # the replica side (and a post-crash recovery) agrees on it
             # even if the in-band context is lost
@@ -368,6 +382,33 @@ class FleetRouter:
                               replica=p.routes[-1].replica,
                               trace_id=telemetry_trace.trace_id_of(rid))
         return p.future
+
+    def submit_shards(self, shards: List[Dict], *, portfolio_id: str,
+                      round_idx: int,
+                      deadline_s: Optional[float] = None,
+                      priority: int = 0) -> Dict[int, Future]:
+        """Route one fleet-sharded portfolio round: each entry of
+        ``shards`` (a ``portfolio_shard`` payload —
+        ``dervet_tpu.portfolio.shard``) becomes one replica request
+        whose rid encodes the portfolio/shard/round.  Stickiness: every
+        shard keys the affinity map by ``(portfolio, shard idx)``, so
+        round k+1's shard i lands on the replica whose compiled
+        programs and ``dual_iterate`` hint table shard i warmed in
+        round k — and a failover re-route updates the same key, so
+        stickiness follows the request to its new home.  Exactly-once
+        delivery, SIGKILL failover, and hedging are the ordinary
+        pending-request machinery; the returned futures deliver
+        :class:`RoutedResult` per shard index."""
+        futs: Dict[int, Future] = {}
+        for shard in shards:
+            i = int(shard.get("shard", len(futs)))
+            rid = f"{portfolio_id}.s{i:02d}.r{int(round_idx):03d}"
+            futs[i] = self.submit(
+                shard["sites"], request_id=rid, priority=priority,
+                deadline_s=deadline_s,
+                affinity_key=f"pfshard:{portfolio_id}:{i}",
+                extra={"portfolio_shard": shard})
+        return futs
 
     def _retry_hint(self, name: str) -> float:
         """Seconds a rejected caller should wait for ``name`` to drain:
@@ -431,7 +472,8 @@ class FleetRouter:
                          deadline_epoch=p.deadline_epoch,
                          payload=self._payload_for(p, h),
                          trace_ctx=(p.span.ctx()
-                                    if p.span is not None else None))
+                                    if p.span is not None else None),
+                         **({"extra": p.extra} if p.extra else {}))
             except QueueFullError as e:
                 # the replica's own drain-rate hint: keep it, try the
                 # next replica (the router redirect), surface the MIN
@@ -503,7 +545,8 @@ class FleetRouter:
             p.payload = SpoolReplica.encode_payload(
                 p.cases, priority=p.priority,
                 deadline_epoch=p.deadline_epoch,
-                trace=(p.span.ctx() if p.span is not None else None))
+                trace=(p.span.ctx() if p.span is not None else None),
+                extra=p.extra)
         return p.payload
 
     def _load_score(self, name: str) -> tuple:

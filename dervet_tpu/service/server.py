@@ -153,7 +153,11 @@ class ScenarioService:
         self._portfolio = {"requests": 0, "outer_rounds": 0,
                            "windows": 0, "dual_iterate_seeds": 0,
                            "degraded_answers": 0, "infeasible": 0,
-                           "failed": 0, "portfolio_s": 0.0}
+                           "failed": 0, "portfolio_s": 0.0,
+                           # fleet-sharded rounds served FOR other
+                           # nodes' dual loops (portfolio/shard.py)
+                           "shard_requests": 0, "shard_windows": 0,
+                           "shard_failed": 0, "shard_s": 0.0}
         # the last portfolio solve's observability section (gap, rounds,
         # certificate) — the smoke/bench gates' surface
         self.last_portfolio: Optional[Dict] = None
@@ -279,9 +283,34 @@ class ScenarioService:
                            kind="portfolio", portfolio_spec=spec,
                            trace_ctx=trace_ctx)
 
+    def submit_portfolio_shard(self, shard: Dict, *, request_id=None,
+                               priority: int = 0,
+                               deadline_s: Optional[float] = None,
+                               trace_ctx: Optional[Dict] = None) -> Future:
+        """Admit one PORTFOLIO SHARD request: a slice of another node's
+        dual round (site cases + the round's dual-price vector — see
+        ``dervet_tpu.portfolio.shard``), solved against THIS replica's
+        persistent solver cache and answered as a
+        :class:`~dervet_tpu.portfolio.shard.PortfolioShardResult`.  The
+        router keeps shard→replica assignment sticky, so round k+1's
+        shard finds the ``dual_iterate`` hints round k stored here."""
+        import hashlib
+        if self._draining.is_set():
+            raise ServiceClosedError(
+                "service is draining — no new admissions")
+        if not isinstance(shard, dict) or not shard.get("sites"):
+            raise ValueError("a portfolio shard needs a non-empty "
+                             "'sites' dict")
+        h = hashlib.sha256()
+        h.update(str(shard.get("seed_tag")).encode())
+        h.update(repr(sorted(str(k) for k in shard["sites"])).encode())
+        return self._admit(request_id, h.hexdigest(), priority,
+                           deadline_s, kind="portfolio_shard",
+                           shard_payload=shard, trace_ctx=trace_ctx)
+
     def _admit(self, request_id, fingerprint, priority, deadline_s, *,
                cases=None, kind: str = "scenario", design_case=None,
-               design_spec=None, portfolio_spec=None,
+               design_spec=None, portfolio_spec=None, shard_payload=None,
                trace_ctx: Optional[Dict] = None) -> Future:
         """Shared admission tail: backend breaker, poison blocklist,
         id allocation/validation, queue put with typed rejection."""
@@ -326,6 +355,7 @@ class ScenarioService:
         req.design_case = design_case
         req.design_spec = design_spec
         req.portfolio_spec = portfolio_spec
+        req.shard_payload = shard_payload
         # telemetry: the request's root span on this process — a child
         # of the upstream (router) context when one rode the transport,
         # else a fresh root whose trace id derives from the request id
@@ -400,6 +430,11 @@ class ScenarioService:
         # trace context rides the transport payload: the replica-side
         # span tree parents under the router's transport span
         kwargs.setdefault("trace_ctx", payload.get("trace"))
+        if payload.get("portfolio_shard") is not None:
+            # fleet-sharded portfolio round: one shard of another
+            # node's dual loop (dervet_tpu/portfolio/shard.py)
+            return self.submit_portfolio_shard(
+                payload["portfolio_shard"], **kwargs)
         return self.submit(payload["cases"], **kwargs)
 
     def submit_design_file(self, path, base_path=None, **kwargs) -> Future:
@@ -518,7 +553,38 @@ class ScenarioService:
                           if r.kind == "portfolio"]
         certified = [r for r in certified if r.kind != "portfolio"]
         degraded = [r for r in degraded if r.kind != "portfolio"]
+        # portfolio SHARD requests (one slice of another node's dual
+        # round): latency-critical sub-steps of a loop already in
+        # flight elsewhere — served first, never shed (the owning
+        # loop's degraded decision was made at ITS admission)
+        shard_reqs = [r for r in certified + degraded
+                      if r.kind == "portfolio_shard"]
+        certified = [r for r in certified if r.kind != "portfolio_shard"]
+        degraded = [r for r in degraded if r.kind != "portfolio_shard"]
         served = 0
+        if shard_reqs:
+            from ..portfolio.shard import PortfolioShardRound
+            sr = PortfolioShardRound(shard_reqs, backend=self.backend,
+                                     solver_opts=self.solver_opts,
+                                     solver_cache=self.solver_cache,
+                                     supervisor=self.supervisor,
+                                     board=self.breakers)
+            try:
+                sr.run()
+            except BaseException as e:
+                for req in portfolio_reqs + design_reqs + degraded \
+                        + certified:
+                    if not req.future.done():
+                        req.future.set_exception(ServiceClosedError(
+                            f"request {req.request_id!r} not "
+                            "dispatched: the portfolio shard round "
+                            f"failed ({e}) — resubmit"))
+                        with self._metrics_lock:
+                            self._requests["failed"] += 1
+                self._absorb_shard_stats(sr)
+                raise
+            self._absorb_shard_stats(sr)
+            served += len(sr.answered)
         if portfolio_reqs:
             from ..portfolio.service import PortfolioRound
             pr = PortfolioRound(portfolio_reqs, backend=self.backend,
@@ -656,6 +722,25 @@ class ScenarioService:
                     self._note_request_telemetry(req, False)
         if dr.last_screen is not None:
             self.last_screen_stats = dr.last_screen
+
+    def _absorb_shard_stats(self, sr) -> None:
+        """Portfolio-shard-round bookkeeping + request accounting (the
+        round answers every future itself)."""
+        st = sr.stats
+        with self._metrics_lock:
+            for k in ("shard_requests", "shard_windows", "shard_failed"):
+                self._portfolio[k] += int(st.get(k, 0))
+            self._portfolio["shard_s"] += float(st.get("shard_s", 0.0))
+            for req in sr.answered:
+                fut = req.future
+                if fut.done() and fut.exception() is None:
+                    self._requests["completed"] += 1
+                    self._latencies.append(
+                        time.monotonic() - req.t_submit)
+                    self._note_request_telemetry(req, True)
+                elif fut.done():
+                    self._requests["failed"] += 1
+                    self._note_request_telemetry(req, False)
 
     def _absorb_portfolio_stats(self, pr) -> None:
         """Portfolio-round bookkeeping + request accounting (the round
@@ -895,7 +980,8 @@ class ScenarioService:
             # request/round counters plus the last dual loop's full
             # observability section (gap, per-round seeding, cert)
             "portfolio": {**{k: (round(v, 3)
-                                 if k == "portfolio_s" else v)
+                                 if k in ("portfolio_s", "shard_s")
+                                 else v)
                              for k, v in portfolio.items()},
                           "last": self.last_portfolio},
             "batch_occupancy": {

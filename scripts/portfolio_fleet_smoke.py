@@ -1,0 +1,197 @@
+"""CI smoke: fleet-sharded portfolio dual rounds under a real SIGKILL.
+
+Boots a 2-replica fleet (real ``dervet-tpu serve`` subprocesses over
+file spools, CPU backend), then solves ONE coupled portfolio whose dual
+rounds are sharded ACROSS the fleet: each outer round ships two
+``portfolio_shard`` requests (site cases + the round's dual-price
+vector) through :class:`~dervet_tpu.service.router.FleetRouter.
+submit_shards`, and one replica is SIGKILLed mid-loop.  The contract
+under fire:
+
+* **sticky shards** — before the kill, each shard index lands on the
+  SAME replica round over round (per-shard affinity keys: that replica's
+  compiled programs and ``dual_iterate`` hint table are warm for it),
+  and the two shards are spread over both replicas;
+* **re-route, 0 lost** — the dead replica's shard re-routes through the
+  PR-10 exactly-once failover machinery (router failover/reroute
+  counters nonzero), every subsequent round runs entirely on the
+  survivor, and the dual loop never loses a site or a round;
+* **gap reached** — the loop still converges to the spec tolerance
+  within the outer budget;
+* **100% certified** — every member site's final-iterate windows carry
+  accepted float64 certificates and the portfolio certificate
+  (coupling feasibility + Lagrangian gap) accepts.
+
+Env knobs: SMOKE_SITES (default 16), SMOKE_HOURS (48), SMOKE_WINDOW
+(24), SMOKE_SLOW_S (default 0.08 — per-solve injected delay so the
+SIGKILL reliably lands while a round is in flight).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_SITES = int(os.environ.get("SMOKE_SITES", "16"))
+HOURS = int(os.environ.get("SMOKE_HOURS", "48"))
+WINDOW = int(os.environ.get("SMOKE_WINDOW", "24"))
+SLOW_S = os.environ.get("SMOKE_SLOW_S", "0.08")
+
+
+def log(msg: str) -> None:
+    print(f"portfolio-fleet-smoke: {msg}", file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    import tempfile
+
+    from dervet_tpu.ops.certify import validate_portfolio_certification
+    from dervet_tpu.portfolio import (PortfolioSpec, solve_portfolio,
+                                      validate_portfolio_section)
+    from dervet_tpu.portfolio.service import synthetic_portfolio_members
+    from dervet_tpu.service import FleetRouter, spawn_replica
+
+    def members():
+        return synthetic_portfolio_members(N_SITES, hours=HOURS,
+                                           window=WINDOW, seed=0,
+                                           pv_kw=9000.0)
+
+    # binding cap from an unconstrained local probe (round 0 of a
+    # 1-round solve IS the independent fleet solve)
+    probe = solve_portfolio(
+        PortfolioSpec(members=members(), export_cap_kw=1e9, max_outer=1),
+        backend="cpu")
+    cap = float(probe.aggregate["net_export"].max()) - 250.0 * N_SITES
+    spec = PortfolioSpec(members=members(), export_cap_kw=cap,
+                         gap_tol=1e-6, feas_tol=1e-7, max_outer=40,
+                         shards=2)
+
+    workdir = Path(tempfile.mkdtemp(prefix="pf-fleet-smoke-"))
+    log(f"spooling under {workdir}")
+    # every solve carries a small injected delay so a round is reliably
+    # IN FLIGHT when the SIGKILL lands (the delay is outside the solver
+    # — correctness untouched)
+    env = {"DERVET_TPU_FAULT_SLOW": "all",
+           "DERVET_TPU_FAULT_SLOW_S": SLOW_S}
+    reps, logs = [], []
+    for i in range(2):
+        name = f"r{i}"
+        logf = open(workdir / f"{name}.log", "w")
+        logs.append(logf)
+        reps.append(spawn_replica(
+            workdir / name, name=name, backend="cpu", stdout=logf,
+            stderr=logf, env=env,
+            extra_args=["--memory-export-s", "0.5"]))
+    router = FleetRouter(reps, fleet_dir=workdir / "router",
+                         heartbeat_timeout_s=1.5, tick_s=0.05,
+                         hedging=False).start()
+
+    kill_state = {"victim": None, "killed_at_round": None}
+
+    def on_round(k: int, result) -> None:
+        if k != 1 or kill_state["victim"] is not None:
+            return
+        # rounds 0-1 established the sticky assignment; kill the replica
+        # that owns shard 1, a beat AFTER round 2's shards go out so the
+        # failover genuinely recovers an in-flight shard request
+        detail = result.rounds[1]["shard_detail"]
+        victim_name = next(d["replica"] for d in detail
+                           if d["shard"] == 1)
+        victim = next(r for r in reps if r.name == victim_name)
+        kill_state["victim"] = victim_name
+        kill_state["killed_at_round"] = k + 1
+
+        def _kill():
+            time.sleep(0.4)
+            victim.process.send_signal(signal.SIGKILL)
+            log(f"SIGKILLed {victim_name} (pid {victim.process.pid}) "
+                f"with round {k + 1} in flight")
+        threading.Thread(target=_kill, daemon=True).start()
+
+    t0 = time.time()
+    try:
+        res = solve_portfolio(spec, backend="cpu", fleet=router,
+                              request_id="pfsmoke", on_round=on_round)
+        m = router.metrics()
+    finally:
+        router.close()
+        for f in logs:
+            f.close()
+    wall = time.time() - t0
+
+    # ---- gate 1: gap reached, 0 lost ---------------------------------
+    assert kill_state["victim"] is not None, "kill never armed"
+    if not res.converged or res.gap_rel > spec.gap_tol:
+        raise AssertionError(
+            f"dual loop did not reach the gap after the kill "
+            f"(rounds {res.outer_rounds}, gap {res.gap_rel:.3e})")
+    section = validate_portfolio_section(res.portfolio_section())
+    assert section["shards"] == 2
+    for r in res.rounds:
+        got = sum(d["sites"] for d in r["shard_detail"])
+        assert got == N_SITES, \
+            f"round {r['round']}: {got}/{N_SITES} sites answered"
+
+    # ---- gate 2: sticky before the kill, survivor-only after ---------
+    pre = [r["shard_detail"] for r in res.rounds[:2]]
+    homes = {d["shard"]: d["replica"] for d in pre[0]}
+    assert set(homes.values()) == {"r0", "r1"}, \
+        f"shards not spread over both replicas: {homes}"
+    for rnd in pre[1:]:
+        for d in rnd:
+            assert d["replica"] == homes[d["shard"]], \
+                f"sticky assignment broken before the kill: {pre}"
+    victim = kill_state["victim"]
+    survivor = next(n for n in ("r0", "r1") if n != victim)
+    post = [r["shard_detail"] for r in res.rounds
+            if r["round"] > kill_state["killed_at_round"]]
+    assert post, "loop converged before any post-kill round"
+    for rnd in post:
+        for d in rnd:
+            assert d["replica"] == survivor, \
+                f"post-kill shard not on the survivor: {rnd}"
+
+    # ---- gate 3: the failover machinery really fired -----------------
+    r = m["routing"]
+    assert r["failovers"] >= 1 or r["rerouted"] + r["harvested"] >= 1, \
+        f"no failover recorded: {r}"
+    assert m["replicas"][victim]["state"] == "dead", m["replicas"]
+
+    # ---- gate 4: 100% certified --------------------------------------
+    validate_portfolio_certification(res.certification)
+    ps = res.certification["per_site"]
+    if not ps["all_certified"] or res.certification["verdict"] not in (
+            "certified", "certified_loose"):
+        raise AssertionError(
+            f"portfolio not fully certified: {res.certification}")
+
+    print(json.dumps({
+        "smoke": "portfolio_fleet", "ok": True,
+        "sites": N_SITES, "shards": 2,
+        "outer_rounds": res.outer_rounds,
+        "gap_rel": res.gap_rel,
+        "victim": victim, "survivor": survivor,
+        "killed_at_round": kill_state["killed_at_round"],
+        "failovers": r["failovers"], "rerouted": r["rerouted"],
+        "harvested": r["harvested"],
+        "memory_handoffs": r["memory_handoffs"],
+        "verdict": res.certification["verdict"],
+        "wall_s": round(wall, 1),
+        "assignment": [{d["shard"]: d["replica"]
+                        for d in rr["shard_detail"]}
+                       for rr in res.rounds],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

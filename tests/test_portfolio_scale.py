@@ -1,0 +1,438 @@
+"""Fleet-sharded portfolio dual rounds + stabilized Dantzig-Wolfe master.
+
+The PR-15 contract under test:
+
+* STABILIZATION — the in-out / proximal-level master step converges in
+  strictly fewer outer rounds than the unstabilized (PR-13 three-regime)
+  control at the 16-site smoke shape, WITHOUT moving the answer: the
+  monolithic-reference parity stays <= 1e-6, the ``diverging_duals``
+  drill still converges + certifies with stabilization on, and the
+  ``DERVET_TPU_PORTFOLIO_STABILIZE=0`` kill switch is bit-for-bit
+  equivalent to ``master_stabilization=False``;
+* SHARD PLANNER — deterministic, structure-aware (fingerprint groups
+  stay together until they must split), clamped to the site count,
+  LPT-balanced by window count;
+* SHARDED-ROUND PARITY — for a FIXED shard plan the per-site columns and
+  costs are identical to the single-host path: a local-sharded solve is
+  byte-identical to the monolithic one (duals, aggregate, site
+  solutions), and a FLEET-sharded solve (real ``FleetRouter`` over
+  ``LocalReplica`` services) matches it too, with shard->replica
+  assignment STICKY across rounds;
+* HINT HANDOFF — ``dual_iterate`` hint-table entries ride the fleet
+  memory payload (``export_payload``/``import_payload``), so a failover
+  or re-routed portfolio shard reseeds mid-dual-loop instead of
+  restarting its sites cold; legacy payloads (bare entries list / dict
+  without "hints") still import.
+"""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from dervet_tpu.ops.warmstart import SolutionMemory
+from dervet_tpu.portfolio import (PortfolioSpec, monolithic_reference,
+                                  solve_portfolio,
+                                  validate_portfolio_section)
+from dervet_tpu.portfolio.service import synthetic_portfolio_members
+from dervet_tpu.portfolio.shard import merge_summaries, plan_shards
+from dervet_tpu.utils import faultinject
+from dervet_tpu.utils.errors import ParameterError
+
+
+def _members(n=8, hours=48, window=24, seed=0):
+    return synthetic_portfolio_members(n, hours=hours, window=window,
+                                       seed=seed, pv_kw=9000.0)
+
+
+def _binding_cap(n=8, hours=48, window=24, margin=1500.0):
+    probe = solve_portfolio(
+        PortfolioSpec(members=_members(n, hours, window),
+                      export_cap_kw=1e9, max_outer=1), backend="cpu")
+    return float(probe.aggregate["net_export"].max()) - margin
+
+
+def _assert_same_bytes(a, b):
+    """Byte-level equality of two portfolio results (the fixed-plan
+    parity contract: duals, aggregate, every site solution array)."""
+    assert a.aggregate["net_export"].tobytes() == \
+        b.aggregate["net_export"].tobytes()
+    for kind in a.duals:
+        assert a.duals[kind].tobytes() == b.duals[kind].tobytes(), kind
+    for key, arrs in a.site_solutions.items():
+        for name, arr in arrs.items():
+            assert arr.tobytes() == \
+                b.site_solutions[key][name].tobytes(), (key, name)
+
+
+# ---------------------------------------------------------------------------
+# Stabilized Dantzig-Wolfe master
+# ---------------------------------------------------------------------------
+
+class TestStabilization:
+    def test_cuts_rounds_vs_control_16_sites(self, monkeypatch):
+        """The smoke-shape acceptance gate: stabilization reaches the
+        gap in STRICTLY fewer outer rounds than the PR-13 control."""
+        # margin 4000 kW: the cap binds hard enough that the control's
+        # harmonic-decay tail is long (15 rounds vs 5 measured) — a
+        # soft cap converges in 2 rounds both ways and gates nothing
+        cap = _binding_cap(16, margin=4000.0)
+        spec = PortfolioSpec(members=_members(16), export_cap_kw=cap,
+                             gap_tol=1e-6, feas_tol=1e-7, max_outer=60)
+        stab = solve_portfolio(spec, backend="cpu")
+        monkeypatch.setenv("DERVET_TPU_PORTFOLIO_STABILIZE", "0")
+        control = solve_portfolio(spec, backend="cpu")
+        assert stab.converged and control.converged
+        assert stab.stabilized and not control.stabilized
+        assert stab.outer_rounds < control.outer_rounds, \
+            (stab.outer_rounds, control.outer_rounds)
+        # the round records say which regime each step ran
+        regimes = {r["regime"] for r in stab.rounds}
+        assert regimes & {"in_out_serious", "in_out_null",
+                          "in_out_exact"}, regimes
+        assert not any(str(r["regime"]).startswith("in_out")
+                       for r in control.rounds)
+
+    def test_monolithic_parity_preserved(self):
+        """Stabilization must not move the answer: 2-site toy matches
+        the monolithic HiGHS coupled LP to 1e-6 (same gate as PR 13)."""
+        cap = _binding_cap(2, margin=800.0)
+        spec = PortfolioSpec(members=_members(2), export_cap_kw=cap,
+                             gap_tol=1e-9, feas_tol=1e-7, max_outer=60)
+        res = solve_portfolio(spec, backend="cpu")
+        assert res.converged and res.stabilized
+        mono = monolithic_reference(
+            PortfolioSpec(members=_members(2), export_cap_kw=cap))
+        assert mono["status"] == 0
+        rel = abs(res.primal_objective - mono["objective_cx"]) \
+            / (1.0 + abs(mono["objective_cx"]))
+        assert rel < 1e-6, (res.primal_objective, mono["objective_cx"])
+
+    def test_kill_switch_matches_spec_off_bitwise(self, monkeypatch):
+        """DERVET_TPU_PORTFOLIO_STABILIZE=0 and
+        ``master_stabilization=False`` run the SAME legacy loop — the
+        kill switch restores it bit for bit."""
+        cap = _binding_cap()
+        spec_env = PortfolioSpec(members=_members(), export_cap_kw=cap,
+                                 gap_tol=1e-4, feas_tol=1e-6,
+                                 max_outer=40)
+        monkeypatch.setenv("DERVET_TPU_PORTFOLIO_STABILIZE", "0")
+        a = solve_portfolio(spec_env, backend="cpu")
+        monkeypatch.delenv("DERVET_TPU_PORTFOLIO_STABILIZE")
+        spec_off = PortfolioSpec(members=_members(), export_cap_kw=cap,
+                                 gap_tol=1e-4, feas_tol=1e-6,
+                                 max_outer=40,
+                                 master_stabilization=False)
+        b = solve_portfolio(spec_off, backend="cpu")
+        assert not a.stabilized and not b.stabilized
+        assert a.outer_rounds == b.outer_rounds
+        _assert_same_bytes(a, b)
+
+    def test_diverging_duals_converges_certified_stabilized(self):
+        """The PR-13 corruption drill under the stabilized master: the
+        non-monotone bound is detected, the step contracts toward the
+        stability center, and the loop still converges + certifies."""
+        probe = solve_portfolio(
+            PortfolioSpec(members=_members(4, hours=336, window=168),
+                          export_cap_kw=1e9, max_outer=1),
+            backend="jax")
+        cap = float(probe.aggregate["net_export"].max()) - 2000.0
+        with faultinject.inject(diverge_duals_round=1,
+                                diverge_duals_scale=25.0) as plan:
+            res = solve_portfolio(
+                PortfolioSpec(members=_members(4, hours=336,
+                                               window=168),
+                              export_cap_kw=cap, max_outer=14),
+                backend="jax")
+        assert ("diverging_duals", "1") in plan.fired
+        assert res.stabilized
+        assert res.dual_rescales >= 1
+        assert res.converged
+        assert res.certification["verdict"] in ("certified",
+                                                "certified_loose")
+
+    def test_section_schema_carries_new_fields(self):
+        cap = _binding_cap(2, margin=800.0)
+        res = solve_portfolio(
+            PortfolioSpec(members=_members(2), export_cap_kw=cap,
+                          gap_tol=1e-4, max_outer=20), backend="cpu")
+        section = validate_portfolio_section(res.portfolio_section())
+        assert section["stabilized"] is True
+        assert section["shards"] == 1
+        assert all("regime" in r and "shards" in r
+                   for r in section["rounds"])
+
+
+# ---------------------------------------------------------------------------
+# The shard planner
+# ---------------------------------------------------------------------------
+
+class _FakeScen:
+    def __init__(self, n_windows):
+        self.windows = list(range(n_windows))
+
+
+class TestShardPlanner:
+    def test_deterministic_and_partitioning(self):
+        scens = {f"s{i:02d}": _FakeScen(2) for i in range(10)}
+        fps = {k: f"fp{i % 3}" for i, k in enumerate(sorted(scens))}
+        a = plan_shards(scens, 3, fingerprints=fps)
+        b = plan_shards(scens, 3, fingerprints=fps)
+        assert a == b
+        flat = sorted(k for shard in a for k in shard)
+        assert flat == sorted(scens)
+        assert len(a) == 3
+
+    def test_structure_groups_stay_together(self):
+        """Sites sharing a fingerprint co-batch — the planner keeps a
+        group on one shard when it fits the per-shard target."""
+        scens = {f"s{i}": _FakeScen(2) for i in range(6)}
+        fps = {"s0": "A", "s1": "A", "s2": "A",
+               "s3": "B", "s4": "B", "s5": "B"}
+        plan = plan_shards(scens, 2, fingerprints=fps)
+        assert len(plan) == 2
+        shard_fps = [{fps[k] for k in shard} for shard in plan]
+        assert all(len(s) == 1 for s in shard_fps), plan
+
+    def test_clamps_to_site_count_and_drops_empty(self):
+        scens = {f"s{i}": _FakeScen(1) for i in range(3)}
+        fps = {k: "same" for k in scens}
+        plan = plan_shards(scens, 8, fingerprints=fps)
+        assert len(plan) <= 3
+        assert sorted(k for s in plan for k in s) == sorted(scens)
+
+    def test_one_shard_is_identity(self):
+        scens = {f"s{i}": _FakeScen(1) for i in range(4)}
+        assert plan_shards(scens, 1) == [sorted(scens)]
+
+    def test_lpt_balances_window_cost(self):
+        scens = {"big0": _FakeScen(8), "big1": _FakeScen(8),
+                 "a": _FakeScen(1), "b": _FakeScen(1),
+                 "c": _FakeScen(1), "d": _FakeScen(1)}
+        fps = {k: k for k in scens}          # all distinct structures
+        plan = plan_shards(scens, 2, fingerprints=fps)
+        loads = [sum(len(scens[k].windows) for k in shard)
+                 for shard in plan]
+        assert max(loads) - min(loads) <= 2, (plan, loads)
+
+    def test_spec_knobs(self, monkeypatch):
+        spec = PortfolioSpec(members=_members(4), export_cap_kw=1.0,
+                             shards=3)
+        assert spec.effective_shards(4) == 3
+        assert spec.effective_shards(2) == 2     # clamped
+        with pytest.raises(ParameterError, match="shards"):
+            PortfolioSpec(members=_members(2), export_cap_kw=1.0,
+                          shards=0).validate()
+        monkeypatch.setenv("DERVET_TPU_PORTFOLIO_SHARDS", "2")
+        spec2 = PortfolioSpec(members=_members(4), export_cap_kw=1.0)
+        assert spec2.effective_shards(4) == 2    # env fills a None
+        assert PortfolioSpec(members=_members(4), export_cap_kw=1.0,
+                             shards=1).effective_shards(4) == 1
+
+    def test_merge_summaries_counters_and_weighted_p50(self):
+        parts = [{"iters_p50": 100.0, "seeded": 2, "dual_iterate": 2,
+                  "substituted": 0, "compile_events": 1, "windows": 6,
+                  "iters_p50_seeded": 90.0, "iters_p50_cold": None},
+                 {"iters_p50": 300.0, "seeded": 1, "dual_iterate": 1,
+                  "substituted": 1, "compile_events": 0, "windows": 2,
+                  "iters_p50_seeded": None, "iters_p50_cold": 320.0}]
+        m = merge_summaries(parts)
+        assert m["windows"] == 8 and m["seeded"] == 3
+        assert m["compile_events"] == 1
+        assert m["iters_p50"] == 100.0       # windows-weighted median
+
+
+# ---------------------------------------------------------------------------
+# Sharded-round parity (local executor)
+# ---------------------------------------------------------------------------
+
+class TestLocalShardParity:
+    def test_sharded_byte_identical_to_monolithic(self):
+        """For a fixed shard plan the per-site columns and costs are
+        identical to the single-host path — cpu backend, so identical
+        means BYTES."""
+        cap = _binding_cap()
+        kw = dict(export_cap_kw=cap, gap_tol=1e-6, feas_tol=1e-7,
+                  max_outer=40)
+        mono = solve_portfolio(
+            PortfolioSpec(members=_members(), **kw), backend="cpu")
+        shard = solve_portfolio(
+            PortfolioSpec(members=_members(), shards=3, **kw),
+            backend="cpu")
+        assert mono.converged and shard.converged
+        assert shard.outer_rounds == mono.outer_rounds
+        assert len(shard.shard_plan) == 3
+        _assert_same_bytes(mono, shard)
+        # per-round shard records carry the observability surface
+        for r in shard.rounds:
+            assert r["shards"] == 3
+            assert len(r["shard_detail"]) == 3
+            assert sum(d["sites"] for d in r["shard_detail"]) == 8
+
+    def test_env_shards_override(self, monkeypatch):
+        cap = _binding_cap(4, margin=800.0)
+        monkeypatch.setenv("DERVET_TPU_PORTFOLIO_SHARDS", "2")
+        res = solve_portfolio(
+            PortfolioSpec(members=_members(4), export_cap_kw=cap,
+                          gap_tol=1e-4, max_outer=30), backend="cpu")
+        assert res.converged
+        assert len(res.shard_plan) == 2
+
+
+# ---------------------------------------------------------------------------
+# Fleet-sharded rounds (real router over LocalReplica services)
+# ---------------------------------------------------------------------------
+
+class TestFleetShardedRounds:
+    def _fleet(self, n=2):
+        from dervet_tpu.service.fleet import LocalReplica
+        from dervet_tpu.service.router import FleetRouter
+        from dervet_tpu.service.server import ScenarioService
+        services = [ScenarioService(backend="cpu", max_wait_s=0.0)
+                    for _ in range(n)]
+        for s in services:
+            s.start()
+        reps = [LocalReplica(f"n{i}", s)
+                for i, s in enumerate(services)]
+        router = FleetRouter(reps, heartbeat_timeout_s=5.0,
+                             hedging=False).start()
+        return router, services
+
+    def test_fleet_round_matches_monolithic_and_sticky(self):
+        cap = _binding_cap()
+        kw = dict(export_cap_kw=cap, gap_tol=1e-6, feas_tol=1e-7,
+                  max_outer=40)
+        mono = solve_portfolio(
+            PortfolioSpec(members=_members(), **kw), backend="cpu")
+        router, services = self._fleet()
+        try:
+            res = solve_portfolio(
+                PortfolioSpec(members=_members(), shards=2, **kw),
+                backend="cpu", fleet=router, request_id="pfx")
+        finally:
+            router.close(terminate_replicas=False)
+            for s in services:
+                s.close()
+        assert res.converged
+        assert res.outer_rounds == mono.outer_rounds
+        _assert_same_bytes(mono, res)
+        # sticky shard->replica assignment: each shard index stays on
+        # the replica that served it in round 0 (hint warmth +
+        # compiled-program affinity live there)
+        detail = [r["shard_detail"] for r in res.rounds]
+        homes = {d["shard"]: d["replica"] for d in detail[0]}
+        assert set(homes.values()) == {"n0", "n1"}   # shards spread
+        for rnd in detail[1:]:
+            for d in rnd:
+                assert d["replica"] == homes[d["shard"]], detail
+        # the replicas counted the shard rounds they served
+        shard_reqs = sum(s.metrics()["portfolio"]["shard_requests"]
+                        for s in services)
+        assert shard_reqs == res.outer_rounds * 2
+
+    def test_two_anonymous_solves_share_one_router(self):
+        """Anonymous solves mint unique portfolio ids — shard rids must
+        not collide with the router's exactly-once memo on a second
+        solve (regression: both used to be 'pf.s00.r000')."""
+        cap = _binding_cap(4, margin=800.0)
+        spec = PortfolioSpec(members=_members(4), export_cap_kw=cap,
+                             gap_tol=1e-4, max_outer=20, shards=2)
+        router, services = self._fleet()
+        try:
+            a = solve_portfolio(spec, backend="cpu", fleet=router)
+            b = solve_portfolio(spec, backend="cpu", fleet=router)
+        finally:
+            router.close(terminate_replicas=False)
+            for s in services:
+                s.close()
+        assert a.converged and b.converged
+        assert a.primal_objective == b.primal_objective
+
+    def test_replica_honors_payload_backend(self):
+        """The shard payload's backend wins on the replica — the owner
+        stamped inner_exact from the backend it requested."""
+        from dervet_tpu.portfolio.shard import solve_portfolio_shard
+        m = _members(2)
+        payload = {"sites": m, "price": np.zeros(48),
+                   "seed_tag": "t", "shard": 0, "round": 0,
+                   "backend": "cpu", "solver_opts": None}
+        res = solve_portfolio_shard(payload)   # no explicit backend
+        assert set(res.outcomes) == set(str(k) for k in m)
+
+    def test_local_shards_share_caller_memory(self):
+        from dervet_tpu.portfolio.shard import LocalShardExecutor
+        m = SolutionMemory(max_entries=4)
+        ex = LocalShardExecutor({}, [[], []], backend="cpu", memory=m)
+        assert all(c.memory is m for c in ex.caches)
+
+    def test_shard_request_admission_validates(self):
+        from dervet_tpu.service.server import ScenarioService
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0)
+        svc.start()
+        try:
+            with pytest.raises(ValueError, match="sites"):
+                svc.submit_portfolio_shard({"sites": {}})
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# dual_iterate hints ride the fleet memory handoff
+# ---------------------------------------------------------------------------
+
+class TestHintHandoff:
+    def test_hints_round_trip_through_payload(self):
+        m = SolutionMemory(max_entries=8)
+        m.store_hint(("pf", "siteA", 0), np.arange(4.0),
+                     np.arange(3.0), -1.5)
+        m.store_hint(("pf", "siteB", 1), np.ones(4), np.zeros(3), -2.5)
+        payload = pickle.loads(pickle.dumps(m.export_payload()))
+        assert payload["hints"]
+        m2 = SolutionMemory(max_entries=8)
+        assert m2.import_payload(payload) == 0   # no primary entries
+        assert m2.stats["imported_hints"] == 2
+        e = m2.lookup_hint(("pf", "siteA", 0))
+        assert e is not None
+        assert np.array_equal(e.x, np.arange(4.0))
+        assert np.array_equal(e.y, np.arange(3.0))
+        assert m2.snapshot()["hint_entries"] == 2
+
+    def test_local_hint_wins_over_import(self):
+        m = SolutionMemory(max_entries=8)
+        m.store_hint(("pf", "s", 0), np.zeros(2), np.zeros(1), 0.0)
+        payload = m.export_payload()
+        m2 = SolutionMemory(max_entries=8)
+        m2.store_hint(("pf", "s", 0), np.ones(2), np.ones(1), 9.0)
+        m2.import_payload(payload)
+        assert np.array_equal(m2.lookup_hint(("pf", "s", 0)).x,
+                              np.ones(2))
+
+    def test_legacy_payloads_still_import(self):
+        m = SolutionMemory(max_entries=8)
+        m2 = SolutionMemory(max_entries=8)
+        # bare entries list (pre-PR-11 replicas)
+        assert m2.import_payload(m.export_entries()) == 0
+        # dict without "hints" (PR-11..14 replicas)
+        assert m2.import_payload({"entries": [], "models": None}) == 0
+        # malformed hint rows are skipped, good ones land — including
+        # an UNHASHABLE key (nested list), which must not abort the
+        # rest of the payload
+        n = m2.import_hints([("bad", {"x": "nope"}),
+                             (("t", ["site", 3]), {"x": np.zeros(1),
+                                                   "y": np.zeros(1),
+                                                   "obj": 0.0}),
+                             (("ok",), {"x": np.zeros(1),
+                                        "y": np.zeros(1),
+                                        "obj": 1.0})])
+        assert n == 1
+        assert m2.lookup_hint(("ok",)) is not None
+
+    def test_hint_table_stays_bounded_on_import(self):
+        m = SolutionMemory(max_entries=4)
+        for i in range(8):
+            m.store_hint(("pf", i), np.zeros(1), np.zeros(1), 0.0)
+        payload = m.export_payload()
+        assert len(payload["hints"]) <= 4
+        m2 = SolutionMemory(max_entries=4)
+        m2.import_hints(payload["hints"])
+        assert m2.snapshot()["hint_entries"] <= 4
