@@ -296,6 +296,11 @@ def main() -> None:
             legs["portfolio_scale"] = portfolio_scale_leg()
         except Exception as e:          # noqa: BLE001
             legs["portfolio_scale"] = {"error": str(e)[:300]}
+    if int(os.environ.get("BENCH_REQUEST_CACHE", "1")):
+        try:
+            legs["request_cache"] = request_cache_leg()
+        except Exception as e:          # noqa: BLE001
+            legs["request_cache"] = {"error": str(e)[:300]}
     config["legs"] = legs
 
     # scale the target linearly if running fewer scenarios than the baseline
@@ -1825,7 +1830,13 @@ def portfolio_scale_leg() -> dict:
     Plus the parity gate both attacks must preserve: on the exact cpu
     backend a sharded solve's answer (duals, aggregate, objective) is
     IDENTICAL to the monolithic one for a fixed shard plan — per-site
-    columns and costs do not depend on which shard solved them."""
+    columns and costs do not depend on which shard solved them.
+
+    And the fleet-transport bytes-on-wire A/B (the replica-side shard
+    case cache): after round 0 seeds each replica, a dual round ships
+    one price vector + plan fingerprint per shard instead of
+    re-pickling every site's payload — gate <= 20% of the full-payload
+    round's bytes."""
     import numpy as _np
 
     from dervet_tpu.portfolio import PortfolioSpec, solve_portfolio
@@ -1924,12 +1935,49 @@ def portfolio_scale_leg() -> dict:
     duals_equal = all(
         _np.array_equal(pm.duals[k], psh.duals[k]) for k in pm.duals)
 
+    # ---- bytes-on-wire: reference rounds on the fleet transport ------
+    # the replica-side shard case cache (service/server.py): round 0
+    # ships full site payloads and seeds each replica's cache; every
+    # later round ships one dual-price vector + a plan fingerprint per
+    # shard.  Measured on the 16-site shape over LocalReplica
+    # transport; the byte counts are the pickled request payloads the
+    # spool transport would write.
+    from dervet_tpu.portfolio.shard import FleetShardExecutor
+    from dervet_tpu.service.fleet import LocalReplica
+    from dervet_tpu.service.router import FleetRouter
+    from dervet_tpu.service.server import ScenarioService
+    wire_services = [ScenarioService(backend="cpu", max_wait_s=0.0)
+                     for _ in range(2)]
+    for s in wire_services:
+        s.start()
+    wire_router = FleetRouter(
+        [LocalReplica(f"w{i}", s) for i, s in enumerate(wire_services)],
+        heartbeat_timeout_s=5.0, hedging=False).start()
+    try:
+        wm = dict(small)
+        wkeys = sorted(wm, key=str)
+        mid = len(wkeys) // 2
+        wex = FleetShardExecutor(wm, [wkeys[:mid], wkeys[mid:]],
+                                 wire_router, backend="cpu",
+                                 portfolio_id="pfwire",
+                                 deadline_s=600.0)
+        wprice = _np.zeros(48)
+        for rnd in range(3):
+            wex.dispatch_round(wprice, rnd)
+        wire_rounds = list(wex.wire_bytes_rounds)
+    finally:
+        wire_router.close(terminate_replicas=False)
+        for s in wire_services:
+            s.close()
+    wire_ratio = wire_rounds[1] / max(wire_rounds[0], 1)
+
     platform = _jax.devices()[0].platform
     real_mesh = platform != "cpu"
     gates = {
         "both_converged": bool(stab.converged and ctrl.converged),
         "stabilized_rounds_cut_ge_40pct": rounds_cut >= 0.40,
         "sharded_parity_exact": bool(duals_equal) and parity_rel < 1e-9,
+        "ref_round_bytes_le_20pct_of_full": wire_ratio <= 0.20,
     }
     if real_mesh:
         gates["sharded_amortized_throughput_ge_monolithic"] = \
@@ -1942,7 +1990,9 @@ def portfolio_scale_leg() -> dict:
         f"round {shard_round_s:.2f}s vs monolithic {mono_round_s:.2f}s "
         f"({shard_wps:.1f} vs {mono_wps:.1f} windows/s, real-mesh "
         f"gated); parity rel {parity_rel:.2e} duals_equal "
-        f"{duals_equal}; gates {'OK' if ok else 'FAIL: ' + str(gates)}")
+        f"{duals_equal}; ref-round wire {wire_rounds[1]} B vs full "
+        f"{wire_rounds[0]} B ({wire_ratio:.1%}); gates "
+        f"{'OK' if ok else 'FAIL: ' + str(gates)}")
     if not ok:
         raise SystemExit(12)
     return {
@@ -1966,6 +2016,8 @@ def portfolio_scale_leg() -> dict:
                     "throughput_x": round(shard_wps / mono_wps, 2)},
         "parity_cpu_16_sites": {"rel_objective": parity_rel,
                                 "duals_equal": bool(duals_equal)},
+        "shard_wire_bytes": {"rounds": wire_rounds,
+                             "ref_to_full_ratio": round(wire_ratio, 4)},
         "stab_rounds": [{k: r[k] for k in
                          ("round", "regime", "step", "gap_rel",
                           "wall_s")} for r in stab.rounds],
@@ -1974,6 +2026,148 @@ def portfolio_scale_leg() -> dict:
                           "wall_s")} for r in ctrl.rounds],
         "gates": gates,
         "gated_on_real_mesh": real_mesh,
+    }
+
+
+def request_cache_leg() -> dict:
+    """Request-level memoization proof (``legs.request_cache``, the
+    router's admission plane — ``service/reqcache.py``): the content-
+    addressed result cache, fleet-wide in-flight dedup, and delta
+    solves, measured against the cold path on a real 2-replica spool
+    fleet.
+
+    Published: cold vs cache-hit latency p50/p99 (a hit answers from
+    the router with zero replica dispatches), the dedup ratio for N
+    identical co-pending requests (one solve, N deliveries), and the
+    delta windows-resolved fraction for a one-window edit.
+
+    Gates: every repeat request a cache hit; hit p99 at least 10x
+    under the cold p50; N co-pending identical requests coalesce to
+    ONE replica solve; the delta diff localizes a one-window edit to
+    <= 10% of the horizon's windows; zero failed requests."""
+    import copy
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    import numpy as _np
+
+    from dervet_tpu.benchlib import synthetic_sensitivity_cases
+    from dervet_tpu.service import FleetRouter, ServiceJournal, \
+        spawn_replica
+
+    n_req = int(os.environ.get("BENCH_REQCACHE_REQUESTS", "6"))
+    n_dup = int(os.environ.get("BENCH_REQCACHE_DUPLICATES", "4"))
+    months = int(os.environ.get("BENCH_REQCACHE_MONTHS", "1"))
+    lengths = (48, 72, 96, 120)
+    workdir = Path(tempfile.mkdtemp(prefix="bench-reqcache-"))
+    log_handles = []
+
+    def workload(tag):
+        out = {}
+        for i in range(n_req):
+            case = synthetic_sensitivity_cases(
+                1, n=lengths[i % len(lengths)], months=months)[0]
+            for t, _, keys in case.ders:
+                if t == "Battery":
+                    keys["ene_max_rated"] = 8000.0 + 10.0 * i
+            out[f"{tag}{i:02d}"] = {0: case}
+        return out
+
+    def run_wave(router, reqs):
+        futs = {rid: router.submit(c, request_id=rid, deadline_s=600.0)
+                for rid, c in reqs.items()}
+        return {rid: f.result(timeout=600) for rid, f in futs.items()}
+
+    reps = []
+    for i in range(2):
+        logf = open(workdir / f"r{i}.log", "w")
+        log_handles.append(logf)
+        reps.append(spawn_replica(workdir / f"r{i}", name=f"r{i}",
+                                  backend="cpu", stdout=logf,
+                                  stderr=logf))
+    router = FleetRouter(reps, fleet_dir=workdir / "fleet",
+                         heartbeat_timeout_s=5.0, tick_s=0.05).start()
+    try:
+        cold = run_wave(router, workload("c."))
+        cold_lat = _np.array(sorted(r.latency_s for r in cold.values()))
+        warm = run_wave(router, workload("h."))
+        hit_lat = _np.array(sorted(r.latency_s for r in warm.values()))
+        hits = sum(1 for r in warm.values() if r.cached)
+
+        # dedup: N identical co-pending requests
+        dup_case = {0: synthetic_sensitivity_cases(
+            1, n=60, months=months)[0]}
+        dup_futs = {f"dup{i}": router.submit(
+                        copy.deepcopy(dup_case), request_id=f"dup{i}",
+                        deadline_s=600.0) for i in range(n_dup)}
+        dup_res = {rid: f.result(timeout=600)
+                   for rid, f in dup_futs.items()}
+        admitted = set()
+        for rep in reps:
+            path = rep.spool / "service_journal.jsonl"
+            if path.exists():
+                admitted.update(ServiceJournal.replay_path(path))
+        dup_solves = len(admitted & set(dup_futs))
+
+        # delta: one-window edit on a 24h-window month
+        base = {0: synthetic_sensitivity_cases(1, n=24, months=1)[0]}
+        router.submit(copy.deepcopy(base), request_id="delta.base",
+                      deadline_s=600.0).result(timeout=600)
+        edited = copy.deepcopy(base)
+        ts = edited[0].datasets.time_series
+        ts.iloc[30, ts.columns.get_loc("DA Price ($/kWh)")] += 0.05
+        router.submit_delta(base, edited, request_id="delta.edit",
+                            deadline_s=600.0).result(timeout=600)
+        events = [json.loads(ln) for ln in
+                  (workdir / "fleet" /
+                   "fleet_journal.jsonl").read_text().splitlines()]
+        note = next(e for e in events if e["event"] == "delta"
+                    and e["rid"] == "delta.edit")
+        m = router.metrics()
+    finally:
+        router.close()
+        for fh in log_handles:
+            fh.close()
+
+    cold_p50 = float(_np.percentile(cold_lat, 50))
+    cold_p99 = float(_np.percentile(cold_lat, 99))
+    hit_p50 = float(_np.percentile(hit_lat, 50))
+    hit_p99 = float(_np.percentile(hit_lat, 99))
+    delta_fraction = (note["windows_changed"] / note["windows_total"]
+                      if note["windows_total"] else 1.0)
+    gates = {
+        "zero_failed": m["routing"]["failed"] == 0,
+        "all_repeats_hit": hits == n_req,
+        "hit_p99_10x_under_cold_p50": hit_p99 < 0.1 * cold_p50,
+        "dedup_single_solve": dup_solves == 1
+        and m["routing"]["duplicates_coalesced"] == n_dup - 1,
+        "delta_fraction_le_10pct": delta_fraction <= 0.10,
+    }
+    ok = all(gates.values())
+    log(f"bench[request_cache]: cold p50/p99 {cold_p50:.2f}/"
+        f"{cold_p99:.2f}s vs hit {hit_p50 * 1e3:.1f}/"
+        f"{hit_p99 * 1e3:.1f}ms ({hits}/{n_req} hits); dedup "
+        f"{n_dup}->{dup_solves} solve; delta resolved "
+        f"{delta_fraction:.1%} of windows; gates "
+        f"{'OK' if ok else 'FAIL: ' + str(gates)}")
+    if not ok:
+        raise SystemExit(13)
+    shutil.rmtree(workdir, ignore_errors=True)
+    return {
+        "requests": n_req,
+        "cold_latency_s": {"p50": round(cold_p50, 3),
+                           "p99": round(cold_p99, 3)},
+        "hit_latency_s": {"p50": round(hit_p50, 5),
+                          "p99": round(hit_p99, 5)},
+        "hit_speedup_p50": round(cold_p50 / max(hit_p50, 1e-9), 1),
+        "cache": m["request_cache"],
+        "dedup": {"co_pending": n_dup, "replica_solves": dup_solves,
+                  "coalesced": m["routing"]["duplicates_coalesced"]},
+        "delta": {"windows_total": note["windows_total"],
+                  "windows_changed": note["windows_changed"],
+                  "resolved_fraction": round(delta_fraction, 4)},
+        "gates": gates,
     }
 
 

@@ -262,5 +262,15 @@ class DERVET:
             # phase decomposed into named device-traffic line items,
             # published by bench.py under legs.*.solve_ledger
             results.solve_ledger = s0.solve_metadata.get("solve_ledger")
+            if isinstance(results.solve_ledger, dict):
+                # provenance stamp, mirrored in run_health: the
+                # request-cache key (service/reqcache.py) folds this in
+                # so a solver upgrade invalidates memoized answers
+                try:
+                    from .ops.pdhg import SOLVER_VERSION
+                    results.solve_ledger.setdefault(
+                        "solver_version", str(SOLVER_VERSION))
+                except Exception:
+                    pass
         TellUser.info(f"DERVET runtime: {done - self.start_time:.2f} s")
         return results

@@ -92,6 +92,15 @@ def run_health_report(health_by_case: Dict, quarantined: Dict,
                                              "watchdog_timeouts")}
                      for k, h in health_by_case.items()},
     }
+    # solver version stamp: provenance for every persisted answer, and
+    # part of the router's request-cache key (service/reqcache.py) so a
+    # numerics upgrade can never serve a stale memoized answer.  Lazy
+    # import — this module stays importable without jax.
+    try:
+        from ..ops.pdhg import SOLVER_VERSION
+        report["solver_version"] = str(SOLVER_VERSION)
+    except Exception:
+        report["solver_version"] = "unknown"
     if certification_by_case is not None:
         from ..ops import certify
         report["certification"] = certify.aggregate_certification(

@@ -44,6 +44,14 @@ from typing import NamedTuple, Optional, Union
 import jax
 import jax.numpy as jnp
 
+# Solver version tag: bump on ANY numerics change that can alter a
+# certified answer (step-size rule, restart policy, equilibration,
+# certification thresholds feeding the ladder).  It is stamped into
+# run_health + the solve ledger and is part of the router's request
+# cache key (service/reqcache.py), so a solver upgrade structurally
+# invalidates every memoized answer it might now produce differently.
+SOLVER_VERSION = "pdhg-18.0"
+
 # Persistent XLA compilation cache: the batched solver's first compile is
 # tens of seconds per (shape, backend) on TPU; caching it on disk makes
 # every later process warm-start.  Opt out with DERVET_TPU_NO_XLA_CACHE=1

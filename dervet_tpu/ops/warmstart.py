@@ -451,6 +451,16 @@ class SolutionMemory:
                 self._unlink(key, self._entries.pop(key))
             self.stats["invalidated"] += len(doomed)
         self.predictor.invalidate(skey)
+        # propagate the trust anomaly up to the request-level result
+        # cache (service/reqcache.py): any memoized whole-request
+        # answer could trace provenance to this memory — rejections
+        # are rare, so every live cache conservatively clears.  Fenced:
+        # cache invalidation must never break the certifier path.
+        try:
+            from ..service import reqcache
+            reqcache.notify_memory_invalidation(skey)
+        except Exception:
+            pass
         return len(doomed)
 
     # -- dual-iterate hint table (portfolio outer loop) -----------------

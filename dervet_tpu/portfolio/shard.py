@@ -40,6 +40,7 @@ accelerators).  This module is that spread:
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import math
 import pickle
 import time
@@ -52,6 +53,24 @@ from ..utils.errors import (DeadlineExpiredError, RequestFailedError,
                             TellUser)
 
 SHARD_RESULT_FILE = "shard_result.pkl"
+
+# rid suffix for the one-shot full-payload resend after a replica-side
+# shard-case-cache miss (rids are once-only across the fleet)
+RESEED_RID_SUFFIX = ".f"
+
+
+def _is_shard_cache_miss(e: BaseException) -> bool:
+    """A replica answered (or rejected at admission) with the typed
+    shard-case-cache miss — synchronously as
+    :class:`~dervet_tpu.utils.errors.ShardCacheMissError` on the local
+    transport, or as a
+    :class:`~dervet_tpu.utils.errors.ReplicaAnswerError` whose payload
+    carries the ``shard_cache_miss`` kind after the spool hop."""
+    from ..utils.errors import ReplicaAnswerError, ShardCacheMissError
+    if isinstance(e, ShardCacheMissError):
+        return True
+    return (isinstance(e, ReplicaAnswerError)
+            and (e.payload or {}).get("kind") == "shard_cache_miss")
 
 
 # ---------------------------------------------------------------------------
@@ -371,37 +390,114 @@ class FleetShardExecutor:
         self.solver_opts = solver_opts
         self.portfolio_id = str(portfolio_id)
         self.deadline_s = float(deadline_s)
-        # shard i's sites never change (fixed plan); NOTE each round
-        # still re-pickles + re-ships the full shard case set through
-        # the transport (only the price genuinely moves) — replica-side
-        # case caching keyed by seed_tag is the 10^4+-site remainder
-        # (ROADMAP item 2)
+        # shard i's sites never change (fixed plan): the full site
+        # payload ships ONCE (round 0, plus a one-shot reseed after a
+        # replica-side cache miss); every later round is a REFERENCE
+        # payload — dual-price vector + plan fingerprint — resolved
+        # against the target replica's bounded shard-case cache
+        # (ScenarioService._resolve_shard_cases, ROADMAP 1a closed)
         self.site_payloads = [{k: members[k] for k in shard}
                               for shard in plan]
+        # plan_fp: a CONTENT fingerprint of the shard's site set — the
+        # replica cache key is (seed_tag, plan_fp), so a same-named
+        # portfolio with edited cases can never resolve a stale site
+        # set.  A case that defeats content digesting disables ref mode
+        # for its shard (every round ships full — correct, just slower).
+        self.plan_fps: List[Optional[str]] = []
+        self.site_bytes: List[int] = []
+        for shard in plan:
+            try:
+                from ..service import reqcache
+                h = hashlib.sha256()
+                for k in shard:
+                    h.update(str(k).encode())
+                    h.update(reqcache.case_content_digest(
+                        members[k]).encode())
+                self.plan_fps.append(h.hexdigest())
+            except Exception:
+                self.plan_fps.append(None)
+        for sp in self.site_payloads:
+            try:
+                self.site_bytes.append(len(pickle.dumps(
+                    sp, protocol=pickle.HIGHEST_PROTOCOL)))
+            except Exception:
+                self.site_bytes.append(0)
+        self._seeded = [False] * len(plan)
         self.assignments: List[Dict[int, str]] = []   # per round
+        self.wire_bytes_rounds: List[int] = []        # per round total
+
+    def _shard_payload(self, i: int, price: np.ndarray, round_idx: int,
+                       *, full: bool) -> Dict:
+        payload = {
+            "price": np.asarray(price, np.float64),
+            "seed_tag": f"{self.portfolio_id}.s{i:02d}",
+            "shard": i,
+            "round": int(round_idx),
+            "backend": self.backend,
+            "solver_opts": self.solver_opts,
+        }
+        if self.plan_fps[i] is not None:
+            payload["plan_fp"] = self.plan_fps[i]
+        if full or self.plan_fps[i] is None:
+            payload["sites"] = self.site_payloads[i]
+        return payload
+
+    def _payload_bytes(self, i: int, payload: Dict) -> int:
+        """Approximate bytes-on-wire for one shard dispatch: the
+        non-site fields pickle cheaply every time; the site set's size
+        was measured once at init (re-pickling it per round to measure
+        it would spend exactly what ref mode saves)."""
+        try:
+            base = len(pickle.dumps(
+                {k: v for k, v in payload.items() if k != "sites"},
+                protocol=pickle.HIGHEST_PROTOCOL))
+        except Exception:
+            base = 0
+        return base + (self.site_bytes[i] if "sites" in payload else 0)
+
+    def _submit_one(self, i: int, payloads: List[Dict],
+                    nbytes: List[int], price: np.ndarray,
+                    round_idx: int):
+        """Admit shard ``i``; a synchronous cache miss (local
+        transport rejects the reference at admission) re-seeds with the
+        full payload once, under a fresh rid."""
+        try:
+            return self.fleet.submit_shards(
+                [payloads[i]], portfolio_id=self.portfolio_id,
+                round_idx=round_idx, deadline_s=self.deadline_s)[i]
+        except Exception as e:
+            if not (_is_shard_cache_miss(e)
+                    and "sites" not in payloads[i]):
+                raise
+            TellUser.info(
+                f"portfolio shard {i} round {round_idx}: replica shard "
+                "cache cold — re-sending the full site payload")
+        payloads[i] = self._shard_payload(i, price, round_idx, full=True)
+        nbytes[i] += self._payload_bytes(i, payloads[i])
+        return self.fleet.submit_shards(
+            [payloads[i]], portfolio_id=self.portfolio_id,
+            round_idx=round_idx, deadline_s=self.deadline_s,
+            rid_suffix=RESEED_RID_SUFFIX)[i]
 
     def dispatch_round(self, price: np.ndarray, round_idx: int,
                        request_id=None) -> RoundData:
-        shards = []
-        for i, shard in enumerate(self.plan):
-            shards.append({
-                "sites": self.site_payloads[i],
-                "price": np.asarray(price, np.float64),
-                "seed_tag": f"{self.portfolio_id}.s{i:02d}",
-                "shard": i,
-                "round": int(round_idx),
-                "backend": self.backend,
-                "solver_opts": self.solver_opts,
-            })
+        n = len(self.plan)
+        payloads = [self._shard_payload(
+            i, price, round_idx,
+            full=not self._seeded[i]) for i in range(n)]
+        nbytes = [self._payload_bytes(i, p)
+                  for i, p in enumerate(payloads)]
         spans = [telemetry_trace.start_span(
             "portfolio_shard", rid=request_id,
             attrs={"shard": i, "round": round_idx, "transport": "fleet",
-                   "sites": len(self.plan[i])})
-            for i in range(len(self.plan))]
+                   "sites": len(self.plan[i]),
+                   "ref_mode": "sites" not in payloads[i]})
+            for i in range(n)]
+        futs: Dict[int, object] = {}
         try:
-            futs = self.fleet.submit_shards(
-                shards, portfolio_id=self.portfolio_id,
-                round_idx=round_idx, deadline_s=self.deadline_s)
+            for i in range(n):
+                futs[i] = self._submit_one(i, payloads, nbytes, price,
+                                           round_idx)
         except BaseException as e:
             for sp in spans:
                 sp.end(error=e)
@@ -415,12 +511,42 @@ class FleetShardExecutor:
                 routed = fut.result(
                     timeout=max(0.1, deadline - time.monotonic()))
             except Exception as e:
-                err = err or RequestFailedError({
-                    f"shard{i}": f"portfolio shard round {round_idx} "
-                                 f"failed on the fleet: "
-                                 f"{type(e).__name__}: {e}"})
-                spans[i].end(error=e)
-                continue
+                if _is_shard_cache_miss(e) and "sites" not in payloads[i]:
+                    # the reference landed on a COLD replica (failover
+                    # moved the shard / eviction / restart): one-shot
+                    # full resend under a fresh rid re-seeds its cache
+                    spans[i].event("shard_cache_miss")
+                    TellUser.info(
+                        f"portfolio shard {i} round {round_idx}: "
+                        "replica shard cache cold — re-sending the "
+                        "full site payload")
+                    payloads[i] = self._shard_payload(
+                        i, price, round_idx, full=True)
+                    nbytes[i] += self._payload_bytes(i, payloads[i])
+                    try:
+                        routed = self.fleet.submit_shards(
+                            [payloads[i]],
+                            portfolio_id=self.portfolio_id,
+                            round_idx=round_idx,
+                            deadline_s=max(
+                                0.1, deadline - time.monotonic()),
+                            rid_suffix=RESEED_RID_SUFFIX)[i].result(
+                            timeout=max(
+                                0.1, deadline - time.monotonic()))
+                    except Exception as e2:
+                        err = err or RequestFailedError({
+                            f"shard{i}": "portfolio shard round "
+                            f"{round_idx} failed after a full-payload "
+                            f"reseed: {type(e2).__name__}: {e2}"})
+                        spans[i].end(error=e2)
+                        continue
+                else:
+                    err = err or RequestFailedError({
+                        f"shard{i}": f"portfolio shard round {round_idx} "
+                                     f"failed on the fleet: "
+                                     f"{type(e).__name__}: {e}"})
+                    spans[i].end(error=e)
+                    continue
             res = routed.result
             if res is None and routed.results_dir is not None:
                 res = load_shard_result(routed.results_dir)
@@ -430,23 +556,29 @@ class FleetShardExecutor:
                                  f"readable {SHARD_RESULT_FILE}"})
                 spans[i].end(error="missing shard result")
                 continue
+            if "sites" in payloads[i] and self.plan_fps[i] is not None:
+                self._seeded[i] = True
             results[i] = res
             assignment[i] = routed.replica
             spans[i].set_attrs({
                 "replica": routed.replica,
                 "windows": res.summary.get("windows"),
                 "recovered": bool(routed.recovered),
+                "payload_bytes": nbytes[i],
                 "wall_s": routed.latency_s})
             spans[i].end()
         if err is not None:
             raise err
         self.assignments.append(assignment)
+        self.wire_bytes_rounds.append(int(sum(nbytes)))
         outcomes: Dict[str, SiteOutcome] = {}
         for res in results.values():
             outcomes.update(res.outcomes)
         records = [{"shard": i, "sites": len(self.plan[i]),
                     "windows": results[i].summary.get("windows"),
                     "replica": assignment[i],
+                    "payload_bytes": nbytes[i],
+                    "ref_mode": "sites" not in payloads[i],
                     "wall_s": (round(float(futs_latency), 3)
                                if (futs_latency := results[i].wall_s)
                                is not None else None)}

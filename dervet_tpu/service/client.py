@@ -8,6 +8,8 @@ backpressure rejections and a blocking solve wrapper.
 """
 from __future__ import annotations
 
+import inspect
+import pickle
 import random
 import time
 from concurrent.futures import Future
@@ -76,12 +78,35 @@ class ScenarioClient:
     def submit(self, cases, *, request_id=None, priority: int = 0,
                deadline_s: Optional[float] = None) -> Future:
         """Admit with bounded, jittered retry-after backoff on
-        queue-full."""
+        queue-full.
+
+        Serialize ONCE: against a fleet router, the case payload is
+        pickled and content-digested here, before the retry loop, and
+        the same bytes/digest ride every attempt — a queue-full storm
+        used to re-pickle the full payload per attempt (and the router
+        needs the digest for its request-cache key anyway)."""
+        kwargs = {}
+        try:
+            params = inspect.signature(self.service.submit).parameters
+        except (TypeError, ValueError):
+            params = {}
+        if "cases_blob" in params and "content_digest" in params:
+            if not isinstance(cases, dict):
+                cases = dict(enumerate(cases))
+            try:
+                from . import reqcache
+                kwargs["cases_blob"] = pickle.dumps(
+                    cases, protocol=pickle.HIGHEST_PROTOCOL)
+                kwargs["content_digest"] = \
+                    reqcache.request_content_digest(cases)
+            except Exception:       # fall back to the plain path
+                kwargs = {}
         return self._submit_with_retry(
             "", lambda: self.service.submit(cases,
                                             request_id=request_id,
                                             priority=priority,
-                                            deadline_s=deadline_s))
+                                            deadline_s=deadline_s,
+                                            **kwargs))
 
     def solve(self, cases, *, timeout: Optional[float] = None,
               **kwargs):

@@ -220,16 +220,27 @@ class SpoolReplica(ReplicaHandle):
     def encode_payload(cases, *, priority: int = 0,
                        deadline_epoch: Optional[float] = None,
                        trace: Optional[Dict] = None,
-                       extra: Optional[Dict] = None) -> bytes:
+                       extra: Optional[Dict] = None,
+                       cases_blob: Optional[bytes] = None) -> bytes:
         # "trace" is the router's telemetry context: the replica's
         # submit_pickle hands it to ScenarioService.submit as trace_ctx;
         # "extra" merges kind extensions (the portfolio_shard payload)
-        # into the same transport record
-        return pickle.dumps({"cases": cases, "priority": int(priority),
-                             "deadline_epoch": deadline_epoch,
-                             **({"trace": trace} if trace else {}),
-                             **(extra or {})},
-                            protocol=pickle.HIGHEST_PROTOCOL)
+        # into the same transport record.  A portfolio_shard extra IS
+        # the request (submit_pickle dispatches on it and never reads
+        # "cases"), so cases are omitted — shipping them too used to
+        # double every shard payload on the wire.  "cases_blob" is the
+        # client's one-time pickle of the cases dict: embedding the
+        # bytes is a memcpy, not a re-serialization of every DataFrame.
+        record = {"priority": int(priority),
+                  "deadline_epoch": deadline_epoch,
+                  **({"trace": trace} if trace else {}),
+                  **(extra or {})}
+        if "portfolio_shard" not in record:
+            if cases_blob is not None:
+                record["cases_pickle"] = cases_blob
+            else:
+                record["cases"] = cases
+        return pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
 
     def _fname(self, rid: str) -> str:
         return f"{rid}{PAYLOAD_SUFFIX}"

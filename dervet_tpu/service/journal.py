@@ -112,7 +112,19 @@ class ServiceJournal:
                 continue        # torn tail from a hard kill: ignore
             rid = str(rec.get("rid"))
             entry = out.setdefault(rid, {"state": None, "file": None})
-            entry["state"] = rec.get("event")
+            event = rec.get("event")
+            # terminal states are FINAL: a non-terminal note appended
+            # after completed/failed (a late hedge/reroute record, a
+            # request-cache annotation) must not resurrect the rid into
+            # a replayable state — recovery would re-serve an already
+            # answered request.  Cancellation may still supersede (the
+            # retract-vs-answer race resolves toward the cancel record,
+            # which only finishes a file removal).
+            if entry["state"] in TERMINAL_EVENTS and \
+                    event not in TERMINAL_EVENTS + (CANCELLED_EVENT,):
+                pass
+            else:
+                entry["state"] = event
             if rec.get("file"):
                 entry["file"] = rec["file"]
             if rec.get("trace_id"):

@@ -37,7 +37,8 @@ from ..utils import faultinject
 from ..utils.errors import (BreakerOpenError, DeadlineExpiredError,  # noqa: F401
                             PoisonRequestError, QueueFullError,
                             RequestFailedError, RequestPreemptedError,
-                            ServiceClosedError, ServiceError, TypedError)
+                            ServiceClosedError, ServiceError,
+                            ShardCacheMissError, TypedError)
 
 
 class QueuedRequest:

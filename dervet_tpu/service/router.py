@@ -60,6 +60,7 @@ from ..utils.breaker import BreakerBoard
 from ..utils.errors import (FleetUnavailableError, QueueFullError,
                             ReplicaAnswerError, ServiceClosedError,
                             TellUser)
+from . import reqcache
 from .fleet import ReplicaHandle, SpoolReplica, structure_fingerprint
 from .journal import ServiceJournal
 from .server import _REQUEST_ID_RE
@@ -81,6 +82,8 @@ class RoutedResult:
     recovered: bool = False      # answered by a failover re-route
     harvested: bool = False      # recovered from a dead replica's spool
     hedged: bool = False         # answered by the hedge route
+    cached: bool = False         # served from the router's result cache
+    coalesced: bool = False      # delivered via in-flight dedup
 
     def load_run_health(self) -> Optional[Dict]:
         """The request's run-health slice (spool transport reads the
@@ -92,6 +95,14 @@ class RoutedResult:
         path = self.results_dir / f"run_health.{self.rid}.json"
         if not path.exists():
             path = self.results_dir / "run_health.json"
+        if not path.exists():
+            # a coalesced follower (or delta) is delivered the LEADER's
+            # artifact set: its files are namespaced by the leader's
+            # rid, not this one — unambiguous when the dir holds a
+            # single request's artifacts
+            named = sorted(self.results_dir.glob("run_health.*.json"))
+            if len(named) == 1:
+                path = named[0]
         try:
             return json.loads(path.read_text())
         except (OSError, ValueError):
@@ -121,7 +132,8 @@ class _Pending:
     __slots__ = ("rid", "fp", "cases", "payload", "priority",
                  "deadline_epoch", "deadline_s", "future", "routes",
                  "t_submit", "answered", "answered_at", "recovered",
-                 "unplaced_since", "span", "extra")
+                 "unplaced_since", "span", "extra", "cache_key",
+                 "cache_material", "followers", "cases_blob")
 
     def __init__(self, rid, fp, cases, priority, deadline_s):
         self.rid = rid
@@ -131,6 +143,15 @@ class _Pending:
         # request-kind extension riding the transport (the
         # portfolio_shard payload); also merged into spool pickles
         self.extra: Optional[Dict] = None
+        # request-cache addressing (reqcache.py): set when this request
+        # is a cacheable leader; followers are co-pending identical
+        # requests coalesced onto this solve (delivered at _deliver)
+        self.cache_key: Optional[str] = None
+        self.cache_material: Optional[Dict] = None
+        self.followers: List["_Pending"] = []
+        # client-serialized case bytes (serialize-once: reused across
+        # queue-full retries AND spool payload encoding)
+        self.cases_blob: Optional[bytes] = None
         self.priority = int(priority)
         self.deadline_s = deadline_s
         self.deadline_epoch = (None if deadline_s is None
@@ -167,7 +188,9 @@ class FleetRouter:
                  placement_patience_s: float = 60.0,
                  probe_timeout_s: Optional[float] = None,
                  breaker_opts: Optional[Dict] = None,
-                 affinity_cap: int = 4096):
+                 affinity_cap: int = 4096,
+                 tolerance_tag: str = "default",
+                 result_cache_entries: int = 256):
         handles = (replicas.values() if isinstance(replicas, dict)
                    else replicas)
         self.replicas: Dict[str, ReplicaHandle] = {
@@ -197,6 +220,24 @@ class FleetRouter:
             self.fleet_dir.mkdir(parents=True, exist_ok=True)
             self.journal = ServiceJournal(
                 self.fleet_dir / "fleet_journal.jsonl")
+        # request-level memoization plane (reqcache.py): the cache key
+        # folds in this router's tolerance tag — a deployment whose
+        # replicas run non-default solver tolerances must set a
+        # distinguishing tag so cross-tolerance hits are impossible.
+        # Construction is file-free (lazy mkdir on first store), so the
+        # DERVET_TPU_REQUEST_CACHE=0 kill switch leaves zero disk state.
+        self.tolerance_tag = str(tolerance_tag)
+        self.result_cache: Optional[reqcache.RequestResultCache] = None
+        if self.fleet_dir is not None:
+            self.result_cache = reqcache.open_cache(
+                self.fleet_dir / "result_cache",
+                max_entries=result_cache_entries)
+        # in-flight dedup: cache key -> leader rid (the one solve N
+        # identical co-pending requests coalesce onto)
+        self._dedup: Dict[str, str] = {}
+        # follower rid -> leader rid (rid once-only bookkeeping for
+        # coalesced requests, which never enter _pending)
+        self._follower_rids: Dict[str, str] = {}
         self._lock = threading.RLock()
         self._pending: Dict[str, _Pending] = {}
         # retired rids (answered) — bounded memo so a rid can neither be
@@ -258,6 +299,9 @@ class FleetRouter:
             "hedge_wins": 0, "duplicates_suppressed": 0,
             "heartbeat_deaths": 0, "probes_sent": 0, "probes_ok": 0,
             "memory_handoffs": 0, "cancels_sent": 0,
+            "request_cache_hits": 0, "request_cache_misses": 0,
+            "request_cache_stores": 0, "duplicates_coalesced": 0,
+            "delta_requests": 0,
         }
         self._latencies = deque(maxlen=4096)
         self._failover_latencies: List[float] = []
@@ -292,7 +336,22 @@ class FleetRouter:
                         p.span.end(error=err)
                         p.span = None
                     p.future.set_exception(err)
+                # coalesced followers ride their leader: fail them too
+                for f in p.followers:
+                    if not f.future.done():
+                        ferr = ServiceClosedError(
+                            f"request {f.rid!r} (coalesced onto "
+                            f"{p.rid!r}) unanswered at fleet router "
+                            "close — resubmit to a live fleet")
+                        if f.span is not None:
+                            telemetry_trace.release_request(f.rid)
+                            f.span.end(error=ferr)
+                            f.span = None
+                        f.future.set_exception(ferr)
+                p.followers = []
             self._pending.clear()
+            self._dedup.clear()
+            self._follower_rids.clear()
         if terminate_replicas:
             for h in self.replicas.values():
                 if isinstance(h, SpoolReplica) and h.process is not None:
@@ -318,18 +377,37 @@ class FleetRouter:
     def submit(self, cases, *, request_id=None, priority: int = 0,
                deadline_s: Optional[float] = None,
                affinity_key: Optional[str] = None,
-               extra: Optional[Dict] = None) -> Future:
+               extra: Optional[Dict] = None,
+               cases_blob: Optional[bytes] = None,
+               content_digest: Optional[str] = None) -> Future:
         """Route one request; returns the future its
         :class:`RoutedResult` (or typed error) is delivered through.
         Raises :class:`FleetUnavailableError` (a ``QueueFullError``,
         ``retry_after_s`` = the smallest hint any replica offered) when
         no replica can take it right now.
 
+        Before any replica is touched, a plain scenario request (no
+        ``extra``, default affinity) consults the request-level
+        memoization plane (``reqcache.py``): a content-addressed result
+        cache HIT answers immediately with the cached byte-identical
+        artifact set (zero replica dispatches); a MISS whose exact
+        content is already being solved by a co-pending request
+        coalesces onto that leader — one solve, N deliveries, each rid
+        journaled and trace-exported separately.  The
+        ``DERVET_TPU_REQUEST_CACHE=0`` kill switch disables the whole
+        plane (bit-for-bit today's path).
+
         ``affinity_key`` overrides the structure-fingerprint affinity
         key (the fleet-sharded portfolio rounds key each SHARD's
         stickiness separately — one portfolio's structure-identical
         shards must spread over replicas, then stay put); ``extra``
-        rides the replica transport as a request-kind extension."""
+        rides the replica transport as a request-kind extension.
+        ``cases_blob`` is the caller's one-time pickle of ``cases``
+        (reused for spool payload encoding instead of re-pickling) and
+        ``content_digest`` its precomputed request content digest —
+        both optional serialize-once fast paths for retry loops."""
+        cached = follower = None
+        t0 = time.monotonic()
         with self._lock:
             if self._closed:
                 raise ServiceClosedError(
@@ -342,7 +420,8 @@ class FleetRouter:
                 raise ValueError(
                     f"request id {rid!r} must match [A-Za-z0-9._-]{{1,64}}"
                     " — it names spool payloads and result artifacts")
-            if rid in self._pending or rid in self._retired:
+            if rid in self._pending or rid in self._retired \
+                    or rid in self._follower_rids:
                 raise ValueError(
                     f"request id {rid!r} was already routed through this "
                     "fleet — ids are once-only (they key the replicas' "
@@ -351,42 +430,206 @@ class FleetRouter:
                 cases = dict(enumerate(cases))
             if not cases:
                 raise ValueError("a request needs at least one case")
-            p = _Pending(rid,
-                         (str(affinity_key) if affinity_key is not None
-                          else structure_fingerprint(cases)),
-                         cases, priority, deadline_s)
-            p.extra = extra
-            # telemetry root span: the trace id derives from the rid, so
-            # the replica side (and a post-crash recovery) agrees on it
-            # even if the in-band context is lost
-            span = telemetry_trace.start_span(
-                "fleet_request",
-                trace_id=telemetry_trace.trace_id_for(rid),
-                attrs={"request_id": rid, "priority": int(priority),
-                       "fingerprint": p.fp[:12]})
-            if span:
-                p.span = span
-                telemetry_trace.register_request(rid, span)
-            try:
-                self._route(p, kind="primary")   # raises if nowhere to go
-            except Exception as e:
-                if p.span is not None:
-                    telemetry_trace.release_request(rid)
-                    p.span.event("rejected", error=type(e).__name__)
-                    p.span.end(error=e)
-                raise
-            self._pending[rid] = p
-            self._counters["submitted"] += 1
+            # -- request-cache admission (plain scenario requests only:
+            # shard/extra traffic and custom-affinity requests bypass)
+            key = material = None
+            if (extra is None and affinity_key is None
+                    and self.result_cache is not None
+                    and reqcache.enabled()):
+                try:
+                    material = reqcache.key_material(
+                        cases, content_digest=content_digest,
+                        tolerance_tag=self.tolerance_tag)
+                    key = reqcache.material_key(material)
+                except Exception as e:     # keying must never block
+                    TellUser.warning(
+                        f"fleet: request-cache key for {rid} failed: {e}")
+                    key = material = None
+            if key is not None:
+                hit = self.result_cache.lookup(key, material)
+                if hit is not None:
+                    cached = self._admit_cached(
+                        rid, priority, key, material, hit, t0)
+                else:
+                    self._counters["request_cache_misses"] += 1
+                    leader = self._pending.get(
+                        self._dedup.get(key, ""))
+                    if leader is not None and not leader.answered \
+                            and leader.cache_key == key:
+                        follower = self._admit_follower(
+                            rid, key, leader, priority, deadline_s)
+            if cached is None and follower is None:
+                p = _Pending(rid,
+                             (str(affinity_key) if affinity_key is not None
+                              else structure_fingerprint(cases)),
+                             cases, priority, deadline_s)
+                p.extra = extra
+                p.cases_blob = cases_blob
+                # telemetry root span: the trace id derives from the
+                # rid, so the replica side (and a post-crash recovery)
+                # agrees on it even if the in-band context is lost
+                span = telemetry_trace.start_span(
+                    "fleet_request",
+                    trace_id=telemetry_trace.trace_id_for(rid),
+                    attrs={"request_id": rid, "priority": int(priority),
+                           "fingerprint": p.fp[:12]})
+                if span:
+                    p.span = span
+                    telemetry_trace.register_request(rid, span)
+                try:
+                    self._route(p, kind="primary")  # raises if nowhere to go
+                except Exception as e:
+                    if p.span is not None:
+                        telemetry_trace.release_request(rid)
+                        p.span.event("rejected", error=type(e).__name__)
+                        p.span.end(error=e)
+                    raise
+                if key is not None:
+                    p.cache_key = key
+                    p.cache_material = material
+                    self._dedup[key] = rid
+                self._pending[rid] = p
+                self._counters["submitted"] += 1
+        if cached is not None:
+            fut = cached
+            if self.journal is not None:
+                self.journal.note(
+                    "request_cache", rid, key=key[:16],
+                    trace_id=telemetry_trace.trace_id_for(rid))
+                self.journal.completed(
+                    rid, trace_id=telemetry_trace.trace_id_for(rid))
+            self._export_trace_best_effort(rid)
+            return fut
+        if follower is not None:
+            fut, leader_rid = follower
+            if self.journal is not None:
+                self.journal.note(
+                    "coalesced", rid, leader=leader_rid,
+                    trace_id=telemetry_trace.trace_id_of(rid))
+            return fut
         if self.journal is not None:
             self.journal.note("routed", rid,
                               replica=p.routes[-1].replica,
                               trace_id=telemetry_trace.trace_id_of(rid))
         return p.future
 
+    def _admit_cached(self, rid: str, priority: int, key: str,
+                      material: Dict, hit, t0: float) -> Future:
+        """Answer one request straight from the result cache (caller
+        holds the lock): no replica is touched, the artifact set is the
+        stored byte-identical copy, and the rid is retired/journaled/
+        trace-exported like any other delivery (exactly-once holds —
+        the rid simply never reaches a spool, so ``recover_spool`` has
+        nothing to reconcile)."""
+        span = telemetry_trace.start_span(
+            "fleet_request",
+            trace_id=telemetry_trace.trace_id_for(rid),
+            attrs={"request_id": rid, "priority": int(priority),
+                   "fingerprint": material["structure"][:12]})
+        latency = time.monotonic() - t0
+        res = RoutedResult(
+            rid=rid, replica="request_cache", result=hit.result,
+            results_dir=hit.results_dir, latency_s=latency, cached=True)
+        self._retire(rid, "request_cache")
+        self._counters["submitted"] += 1
+        self._counters["completed"] += 1
+        self._counters["request_cache_hits"] += 1
+        self._latencies.append(latency)
+        if telemetry_registry.enabled():
+            self._telemetry.histogram(
+                "dervet_fleet_request_latency_seconds").observe(latency)
+        if span:
+            span.event("request_cache", key=key[:16],
+                       source_rid=hit.rid)
+            span.set_attrs({"replica": "request_cache",
+                            "outcome": "done", "cached": True,
+                            "latency_s": round(latency, 6)})
+            span.end()
+        fut: Future = Future()
+        fut.set_result(res)
+        return fut
+
+    def _admit_follower(self, rid: str, key: str, leader: "_Pending",
+                        priority: int, deadline_s) -> tuple:
+        """Coalesce one request onto an identical co-pending leader
+        (caller holds the lock): no route of its own — the leader's
+        first delivery fans out to every follower, each journaled and
+        trace-exported under its own rid.  The leader's deadline
+        governs the solve; a follower's own deadline is advisory."""
+        p = _Pending(rid, leader.fp, None, priority, deadline_s)
+        p.cache_key = key
+        span = telemetry_trace.start_span(
+            "fleet_request",
+            trace_id=telemetry_trace.trace_id_for(rid),
+            attrs={"request_id": rid, "priority": int(priority),
+                   "fingerprint": leader.fp[:12]})
+        if span:
+            p.span = span
+            telemetry_trace.register_request(rid, span)
+            span.event("coalesced", leader=leader.rid, key=key[:16])
+        leader.followers.append(p)
+        self._follower_rids[rid] = leader.rid
+        self._counters["submitted"] += 1
+        self._counters["duplicates_coalesced"] += 1
+        return p.future, leader.rid
+
+    def submit_delta(self, base_cases, edited_cases, *,
+                     request_id=None, priority: int = 0,
+                     deadline_s: Optional[float] = None) -> Future:
+        """Submit ``edited_cases`` as a DELTA against ``base_cases``:
+        per-window data digests (``reqcache.diff_request`` — labeled
+        with the same ``build_optimization_levels`` the scenario
+        windows with) establish exactly which optimization windows the
+        edit touched, and the request is annotated with the diff
+        (``delta`` journal note + span event, ``delta_requests``
+        counter) before routing through :meth:`submit`.
+
+        Device work follows the diff: structure affinity routes the
+        edited request to the replica whose warm memory holds the base
+        solve, where every UNCHANGED window exact-substitutes from the
+        stored solution (re-verified in float64, shipped verbatim —
+        zero device work, byte-identical bytes) and each CHANGED window
+        re-solves seeded at the near/``dual_iterate`` grade.  The
+        merged case re-runs the full invariant audit like any other
+        request, and on the cpu backend the merged answer is
+        byte-identical to a full cold re-solve (gated in
+        tests/smoke).  An edit that changed nothing is answered
+        straight from the whole-request result cache."""
+        if not isinstance(base_cases, dict):
+            base_cases = dict(enumerate(base_cases))
+        if not isinstance(edited_cases, dict):
+            edited_cases = dict(enumerate(edited_cases))
+        try:
+            diff = reqcache.diff_request(base_cases, edited_cases)
+        except Exception:
+            diff = None             # not comparable: all windows changed
+        with self._lock:
+            if request_id is None:
+                self._seq += 1
+                request_id = f"f{self._seq:06d}"
+        rid = str(request_id)
+        fut = self.submit(edited_cases, request_id=rid,
+                          priority=priority, deadline_s=deadline_s)
+        changed = None if diff is None else diff["windows_changed"]
+        total = None if diff is None else diff["windows_total"]
+        with self._lock:
+            self._counters["delta_requests"] += 1
+            p = self._pending.get(rid)
+            if p is not None and p.span is not None:
+                p.span.event("delta", windows_changed=changed,
+                             windows_total=total,
+                             comparable=diff is not None)
+        if self.journal is not None:
+            self.journal.note("delta", rid, windows_changed=changed,
+                              windows_total=total,
+                              comparable=diff is not None)
+        return fut
+
     def submit_shards(self, shards: List[Dict], *, portfolio_id: str,
                       round_idx: int,
                       deadline_s: Optional[float] = None,
-                      priority: int = 0) -> Dict[int, Future]:
+                      priority: int = 0,
+                      rid_suffix: str = "") -> Dict[int, Future]:
         """Route one fleet-sharded portfolio round: each entry of
         ``shards`` (a ``portfolio_shard`` payload —
         ``dervet_tpu.portfolio.shard``) becomes one replica request
@@ -398,13 +641,24 @@ class FleetRouter:
         stickiness follows the request to its new home.  Exactly-once
         delivery, SIGKILL failover, and hedging are the ordinary
         pending-request machinery; the returned futures deliver
-        :class:`RoutedResult` per shard index."""
+        :class:`RoutedResult` per shard index.
+
+        A shard payload without ``"sites"`` is a REFERENCE (rounds ≥ 1
+        of the case-cache protocol: just the dual-price vector + the
+        ``plan_fp`` the target replica resolves against its seeded
+        cache); a tiny placeholder rides the ``cases`` slot — the
+        shard extra IS the request on every transport.  ``rid_suffix``
+        lets the executor's one-shot full-payload resend after a
+        :class:`~dervet_tpu.utils.errors.ShardCacheMissError` use a
+        fresh rid (ids are once-only)."""
         futs: Dict[int, Future] = {}
         for shard in shards:
             i = int(shard.get("shard", len(futs)))
-            rid = f"{portfolio_id}.s{i:02d}.r{int(round_idx):03d}"
+            rid = (f"{portfolio_id}.s{i:02d}.r{int(round_idx):03d}"
+                   f"{rid_suffix}")
             futs[i] = self.submit(
-                shard["sites"], request_id=rid, priority=priority,
+                shard.get("sites") or {"shard_ref": shard.get("plan_fp")},
+                request_id=rid, priority=priority,
                 deadline_s=deadline_s,
                 affinity_key=f"pfshard:{portfolio_id}:{i}",
                 extra={"portfolio_shard": shard})
@@ -546,7 +800,7 @@ class FleetRouter:
                 p.cases, priority=p.priority,
                 deadline_epoch=p.deadline_epoch,
                 trace=(p.span.ctx() if p.span is not None else None),
-                extra=p.extra)
+                extra=p.extra, cases_blob=p.cases_blob)
         return p.payload
 
     def _load_score(self, name: str) -> tuple:
@@ -711,6 +965,7 @@ class FleetRouter:
                 if route.kind == "failover" or harvested:
                     self._failover_latencies.append(latency)
                 losers = p.live_routes()
+                followers = self._detach_followers(p, route.replica)
         if not first:
             # the loser's just-ended transport span re-entered the
             # collector under an already-exported trace id — merge it
@@ -743,8 +998,10 @@ class FleetRouter:
             if self.journal is not None:
                 self.journal.completed(
                     p.rid, trace_id=telemetry_trace.trace_id_of(p.rid))
+            self._maybe_store(p, answer)
             self._finish_trace(p, route, "done", harvested, latency)
             p.future.set_result(res)
+            self._deliver_followers(followers, res=res)
         else:
             err = (answer if isinstance(answer, BaseException)
                    else ReplicaAnswerError(
@@ -762,6 +1019,121 @@ class FleetRouter:
             self._finish_trace(p, route, "failed", harvested, latency,
                                error=err)
             p.future.set_exception(err)
+            self._deliver_followers(followers, err=err,
+                                    replica=route.replica)
+
+    def _detach_followers(self, p: _Pending, replica: str
+                          ) -> List[_Pending]:
+        """First-delivery bookkeeping for the dedup plane (caller holds
+        the lock): drop the in-flight dedup key, retire every coalesced
+        follower rid, and hand the followers back for delivery."""
+        if p.cache_key is not None and \
+                self._dedup.get(p.cache_key) == p.rid:
+            self._dedup.pop(p.cache_key, None)
+        followers, p.followers = p.followers, []
+        for f in followers:
+            self._follower_rids.pop(f.rid, None)
+            self._retire(f.rid, replica)
+        return followers
+
+    def _deliver_followers(self, followers: List[_Pending], *,
+                           res: Optional[RoutedResult] = None,
+                           err=None, replica: str = "") -> None:
+        """Fan the leader's answer out to its coalesced followers: one
+        solve, N deliveries — each follower journaled, trace-exported,
+        and counted under its OWN rid."""
+        for f in followers:
+            latency = time.monotonic() - f.t_submit
+            if self.journal is not None:
+                if err is None:
+                    self.journal.completed(
+                        f.rid,
+                        trace_id=telemetry_trace.trace_id_of(f.rid))
+                else:
+                    self.journal.failed(
+                        f.rid, getattr(err, "payload", None)
+                        or {"message": str(err)},
+                        trace_id=telemetry_trace.trace_id_of(f.rid))
+            with self._lock:
+                if err is None:
+                    self._counters["completed"] += 1
+                    self._latencies.append(latency)
+                else:
+                    self._counters["failed"] += 1
+            if telemetry_registry.enabled() and err is None:
+                self._telemetry.histogram(
+                    "dervet_fleet_request_latency_seconds"
+                ).observe(latency)
+            if f.span is not None:
+                telemetry_trace.release_request(f.rid)
+                f.span.set_attrs({
+                    "replica": res.replica if res is not None else replica,
+                    "outcome": "done" if err is None else "failed",
+                    "coalesced": True, "latency_s": round(latency, 6)})
+                f.span.end(error=err)
+                f.span = None
+            self._export_trace_best_effort(f.rid)
+            if err is None:
+                f.future.set_result(RoutedResult(
+                    rid=f.rid, replica=res.replica, result=res.result,
+                    results_dir=res.results_dir, latency_s=latency,
+                    coalesced=True))
+            else:
+                f.future.set_exception(err)
+
+    def _maybe_store(self, p: _Pending, answer) -> None:
+        """Persist a just-delivered answer into the result cache (the
+        certificate contract — certified, audit-clean, no quarantines —
+        is enforced inside ``RequestResultCache.store``).  Store
+        failures are logged, never raised: the cache must not block
+        delivery."""
+        if p.cache_key is None or self.result_cache is None \
+                or not reqcache.enabled():
+            return
+        try:
+            if isinstance(answer, Path):
+                run_health = None
+                rh = answer / f"run_health.{p.rid}.json"
+                if not rh.exists():
+                    rh = answer / "run_health.json"
+                try:
+                    run_health = json.loads(rh.read_text())
+                except (OSError, ValueError):
+                    run_health = None
+                # serve_main writes fidelity.json only for degraded
+                # (load-shed screening) answers
+                fidelity = ("degraded"
+                            if (answer / "fidelity.json").exists()
+                            else "certified")
+                stored = self.result_cache.store(
+                    p.cache_key, p.cache_material, rid=p.rid,
+                    results_dir=answer, run_health=run_health,
+                    fidelity=fidelity)
+            else:
+                stored = self.result_cache.store(
+                    p.cache_key, p.cache_material, rid=p.rid,
+                    result=answer,
+                    run_health=getattr(answer, "run_health", None),
+                    fidelity=getattr(answer, "fidelity", None))
+            if stored:
+                with self._lock:
+                    self._counters["request_cache_stores"] += 1
+                if p.span is not None:
+                    p.span.event("request_cache_store",
+                                 key=p.cache_key[:16])
+        except Exception as e:
+            TellUser.warning(
+                f"fleet: request-cache store for {p.rid} failed: {e}")
+
+    def _export_trace_best_effort(self, rid: str) -> None:
+        if self.fleet_dir is None or not telemetry_trace.enabled():
+            return
+        try:
+            telemetry_trace.export_request_trace(
+                rid, self.fleet_dir / "traces", chrome=True)
+        except Exception as e:      # observability must never block
+            TellUser.warning(f"fleet: trace export for {rid} "
+                             f"failed: {e}")
 
     def _finish_trace(self, p: _Pending, route: _Route, outcome: str,
                       harvested: bool, latency: float,
@@ -1085,13 +1457,13 @@ class FleetRouter:
                     and now - p.unplaced_since
                     > self.placement_patience_s)
                 if expired or patience_over:
+                    err = FleetUnavailableError(
+                        f"request {p.rid!r} could not be re-placed "
+                        "on any healthy replica"
+                        + (" before its deadline" if expired else
+                           f" within {self.placement_patience_s:g}s"),
+                        retry_after_s=1.0)
                     if not p.future.done():
-                        err = FleetUnavailableError(
-                            f"request {p.rid!r} could not be re-placed "
-                            "on any healthy replica"
-                            + (" before its deadline" if expired else
-                               f" within {self.placement_patience_s:g}s"),
-                            retry_after_s=1.0)
                         if p.span is not None:
                             telemetry_trace.release_request(p.rid)
                             p.span.event("unplaceable",
@@ -1103,6 +1475,8 @@ class FleetRouter:
                         self._retire(p.rid, "")
                         p.answered = True
                         self._pending.pop(p.rid, None)
+                        followers = self._detach_followers(p, "")
+                    self._deliver_followers(followers, err=err)
                     continue
                 self._reroute(p, exclude=(), counter="rerouted")
                 continue
@@ -1181,6 +1555,8 @@ class FleetRouter:
                         "affinity_hit_rate": (
                             round(counters["affinity_hits"] / aff_total, 4)
                             if aff_total else None)},
+            "request_cache": (self.result_cache.snapshot()
+                              if self.result_cache is not None else None),
             "latency_s": {"n": int(lat.size), "p50": pct(lat, 50),
                           "p99": pct(lat, 99),
                           "max": (round(float(lat.max()), 4)

@@ -47,7 +47,8 @@ from ..telemetry import registry as telemetry_registry
 from ..telemetry import trace as telemetry_trace
 from ..utils.breaker import BreakerBoard
 from ..utils.errors import (BreakerOpenError, PoisonRequestError,
-                            PreemptedError, TellUser)
+                            PreemptedError, ShardCacheMissError,
+                            TellUser)
 from ..utils.supervisor import RunSupervisor
 from . import resilience
 from .batcher import BatchRound
@@ -184,6 +185,18 @@ class ScenarioService:
         # manifests key on it), so it is rejected at admission; the id
         # frees the moment its future resolves
         self._active_ids: set = set()
+        # replica-side portfolio shard case cache (ROADMAP 1a): the
+        # full site payload arrives ONCE per (seed_tag, plan_fp); every
+        # later dual round ships just the price vector + the plan
+        # fingerprint and resolves the cases here at admission.  A
+        # reference that misses (failover moved the shard, eviction,
+        # restart) raises the typed ShardCacheMissError, and the shard
+        # executor re-sends the full payload once.  Bounded LRU — a
+        # replica serving many portfolios must not pin every site set.
+        self._shard_cases: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self._shard_cases_cap = 32
+        self._shard_cases_lock = threading.Lock()
         self._metrics_lock = threading.Lock()
         # bounded: the percentile surface only needs a recent window,
         # and a service that never dies must not grow per-request state
@@ -298,15 +311,51 @@ class ScenarioService:
         if self._draining.is_set():
             raise ServiceClosedError(
                 "service is draining — no new admissions")
-        if not isinstance(shard, dict) or not shard.get("sites"):
-            raise ValueError("a portfolio shard needs a non-empty "
-                             "'sites' dict")
+        if not isinstance(shard, dict):
+            raise ValueError("a portfolio shard needs a payload dict")
+        shard = self._resolve_shard_cases(shard)
         h = hashlib.sha256()
         h.update(str(shard.get("seed_tag")).encode())
         h.update(repr(sorted(str(k) for k in shard["sites"])).encode())
         return self._admit(request_id, h.hexdigest(), priority,
                            deadline_s, kind="portfolio_shard",
                            shard_payload=shard, trace_ctx=trace_ctx)
+
+    def _resolve_shard_cases(self, shard: Dict) -> Dict:
+        """Shard case cache admission hook: a FULL payload ("sites"
+        present) seeds the ``(seed_tag, plan_fp)`` entry; a REFERENCE
+        payload (no "sites", a "plan_fp") resolves against it or raises
+        the typed :class:`ShardCacheMissError` so the executor re-sends
+        the full payload once.  The returned shard always carries
+        resolved sites."""
+        sites = shard.get("sites")
+        seed_tag = str(shard.get("seed_tag"))
+        plan_fp = shard.get("plan_fp")
+        if sites:
+            if plan_fp:
+                key = (seed_tag, str(plan_fp))
+                with self._shard_cases_lock:
+                    self._shard_cases[key] = sites
+                    self._shard_cases.move_to_end(key)
+                    while len(self._shard_cases) > self._shard_cases_cap:
+                        self._shard_cases.popitem(last=False)
+            return shard
+        if not plan_fp:
+            raise ValueError("a portfolio shard needs a non-empty "
+                             "'sites' dict (or a 'plan_fp' reference "
+                             "to a previously shipped one)")
+        key = (seed_tag, str(plan_fp))
+        with self._shard_cases_lock:
+            cached = self._shard_cases.get(key)
+            if cached is not None:
+                self._shard_cases.move_to_end(key)
+        if cached is None:
+            raise ShardCacheMissError(
+                f"shard {seed_tag!r} arrived in reference mode but this "
+                f"replica holds no cached site payload for plan "
+                f"{str(plan_fp)[:12]!r} — re-dispatch with the full "
+                "'sites' payload to re-seed the cache")
+        return {**shard, "sites": cached}
 
     def _admit(self, request_id, fingerprint, priority, deadline_s, *,
                cases=None, kind: str = "scenario", design_case=None,
@@ -435,7 +484,12 @@ class ScenarioService:
             # node's dual loop (dervet_tpu/portfolio/shard.py)
             return self.submit_portfolio_shard(
                 payload["portfolio_shard"], **kwargs)
-        return self.submit(payload["cases"], **kwargs)
+        cases = payload.get("cases")
+        if cases is None and payload.get("cases_pickle") is not None:
+            # serialize-once client path: the cases dict rides as its
+            # own pre-pickled bytes inside the transport record
+            cases = pickle.loads(payload["cases_pickle"])
+        return self.submit(cases, **kwargs)
 
     def submit_design_file(self, path, base_path=None, **kwargs) -> Future:
         """Admit a spool ``design.json`` request file (see
@@ -1396,6 +1450,13 @@ def serve_main(argv=None) -> int:
                 except Exception as e:  # unparseable input: park it
                     atomic_write(failed_dir / f"{path.name}.error.txt",
                                  f"{type(e).__name__}: {e}\n")
+                    # the machine-readable form too: typed admission
+                    # rejections (shard_cache_miss above all) must keep
+                    # their kind/retry_hint across the spool hop — the
+                    # shard executor switches on the kind to re-send a
+                    # full payload
+                    atomic_write(failed_dir / f"{path.name}.error.json",
+                                 json.dumps(_error_payload(e)))
                     path.replace(failed_dir / path.name)
                     TellUser.error(f"serve: {rid} rejected at admission: "
                                    f"{e}")

@@ -215,6 +215,23 @@ class FleetUnavailableError(QueueFullError):
     kind = "fleet_unavailable"
 
 
+class ShardCacheMissError(ServiceError):
+    """A REFERENCE-mode portfolio shard payload (dual-price vector +
+    plan fingerprint, no site cases) reached a replica whose shard case
+    cache holds no entry for its ``(seed_tag, plan_fp)`` key — the
+    replica is cold for this shard (a failover moved the shard, the
+    entry was evicted, or the replica restarted).  The shard executor
+    reacts by re-dispatching the SAME shard once with the full site
+    payload, which re-seeds the cache; ``retry_hint`` is 0 because the
+    full resend can go immediately."""
+
+    kind = "shard_cache_miss"
+
+    def __init__(self, msg: str):
+        super().__init__(msg)
+        self.retry_hint = 0.0
+
+
 class ReplicaAnswerError(ServiceError):
     """A spool replica answered the request with a typed failure; the
     router re-raises it on the client future with the replica's

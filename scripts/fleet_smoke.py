@@ -42,6 +42,11 @@ REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# this smoke drills the REPLICA tier (failover, affinity, warm-start
+# repeats): wave 2's identical-content repeats must actually reach the
+# replicas, so the router's request-level memoization plane is pinned
+# off here — it has its own smoke (request_cache_smoke.py)
+os.environ["DERVET_TPU_REQUEST_CACHE"] = "0"
 
 N_REQ = int(os.environ.get("SMOKE_FLEET_REQUESTS", "6"))
 DEADLINE_S = float(os.environ.get("SMOKE_FLEET_DEADLINE_S", "300"))

@@ -1,0 +1,380 @@
+"""CI smoke: the router's request-level memoization plane, end to end.
+
+Boots a 2-replica fleet (real ``dervet-tpu serve`` subprocesses over
+file spools, CPU backend) and drills the four contracts of the
+admission-time result cache (``dervet_tpu.service.reqcache``):
+
+* **repeat wave** — a second wave of identical-content requests under
+  fresh ids is answered straight from the router's content-addressed
+  result cache: ZERO replica dispatches (the new ids never appear in
+  any replica's service journal), byte-identical CSV artifacts, and a
+  hit-path latency far below the cold solve;
+* **fleet-wide dedup** — N identical CO-PENDING requests coalesce at
+  admission into one replica solve; every rid resolves, followers are
+  flagged ``coalesced`` and journaled individually (exactly-once
+  delivery surface intact);
+* **delta solves** — ``submit_delta(base, edited)`` with a one-window
+  time-series edit re-dispatches ONLY the changed window.  Two drills:
+  on the exact cpu fleet the journal diff note says
+  ``windows_changed == 1`` and the merged answer is byte-identical to
+  a full cold re-solve of the edited case on a fresh fleet; on a jax
+  replica (the backend that carries the warm-start memory plane) the
+  delta run's solve ledger shows every unchanged window
+  exact-substituted from the base solve's stored solutions — zero
+  device re-solves outside the edit;
+* **kill switch** — ``DERVET_TPU_REQUEST_CACHE=0`` restores the plain
+  path bit for bit: repeats reach the replicas again, results stay
+  byte-identical, and no cache files or directories are created.
+
+Env knobs: SMOKE_RC_REQUESTS (default 3), SMOKE_RC_DUPLICATES
+(default 4), SMOKE_RC_DEADLINE_S (default 600).
+"""
+from __future__ import annotations
+
+import copy
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# a small per-request solve floor (fault-injected outside the solver)
+# so the co-pending dedup wave reliably overlaps; correctness untouched
+os.environ.setdefault("DERVET_TPU_FAULT_SLOW", "1")
+os.environ.setdefault("DERVET_TPU_FAULT_SLOW_S", "1.0")
+
+N_REQ = int(os.environ.get("SMOKE_RC_REQUESTS", "3"))
+N_DUP = int(os.environ.get("SMOKE_RC_DUPLICATES", "4"))
+DEADLINE_S = float(os.environ.get("SMOKE_RC_DEADLINE_S", "600"))
+
+
+def log(msg: str) -> None:
+    print(f"request-cache-smoke: {msg}", file=sys.stderr, flush=True)
+
+
+def workload():
+    """N requests, one case each: distinct window lengths (distinct LP
+    structures) and distinct battery ratings (distinct content)."""
+    from dervet_tpu.benchlib import synthetic_sensitivity_cases
+    out = {}
+    for i in range(N_REQ):
+        case = synthetic_sensitivity_cases(1, n=48 + 24 * i, months=1)[0]
+        for tag, _, keys in case.ders:
+            if tag == "Battery":
+                keys["ene_max_rated"] = 8000.0 + 10.0 * i
+        out[f"req{i:02d}"] = {0: case}
+    return out
+
+
+def delta_base_case(days=31):
+    """A 24h-window case (``days`` windows) for the delta drills — a
+    structure distinct from every workload() request so affinity
+    routes the delta to the replica holding the base solve."""
+    from dervet_tpu.benchlib import synthetic_sensitivity_cases
+    case = synthetic_sensitivity_cases(1, n=24, months=1)[0]
+    if days < 31:
+        ts = case.datasets.time_series
+        case.datasets.time_series = ts.loc[ts.index.day <= days]
+    return {0: case}
+
+
+def edit_one_window(cases, bump=0.05):
+    """Deep-copy ``cases`` and poke one DA price value inside the
+    SECOND 24h window only — an edit the delta plane localizes to
+    window 1 and that genuinely changes that window's LP (so the
+    byte-identity gate compares real re-solved bytes, not a no-op)."""
+    edited = copy.deepcopy(cases)
+    ts = edited[0].datasets.time_series
+    col = ts.columns.get_loc("DA Price ($/kWh)")
+    ts.iloc[30, col] += bump
+    return edited
+
+
+def spawn_fleet(root: Path, n: int, tag: str):
+    from dervet_tpu.service import spawn_replica
+    reps = []
+    for i in range(n):
+        name = f"{tag}{i}"
+        logf = open(root / f"{name}.log", "w")
+        reps.append(spawn_replica(root / name, name=name, backend="cpu",
+                                  stdout=logf, stderr=logf))
+    return reps
+
+
+def route_wave(router, reqs, rid_prefix=""):
+    return {rid_prefix + rid: router.submit(
+                cases, request_id=rid_prefix + rid, deadline_s=DEADLINE_S)
+            for rid, cases in reqs.items()}
+
+
+def collect(futs, timeout=900):
+    return {rid: fut.result(timeout=timeout) for rid, fut in futs.items()}
+
+
+def csv_surface(results_dir: Path):
+    return {p.name: p.read_bytes()
+            for p in sorted(results_dir.glob("*.csv"))}
+
+
+def replica_rids(reps):
+    """Every rid any replica ever admitted (from the service journals)."""
+    from dervet_tpu.service import ServiceJournal
+    seen = set()
+    for rep in reps:
+        path = rep.spool / "service_journal.jsonl"
+        if path.exists():
+            seen.update(ServiceJournal.replay_path(path))
+    return seen
+
+
+def assert_certified(rid, res):
+    rh = res.load_run_health()
+    assert rh is not None, f"{rid}: no run-health slice"
+    cert = rh["certification"]
+    assert cert["enabled"], f"{rid}: certification disabled"
+    assert cert["windows"]["rejected_final"] == 0, \
+        f"{rid}: final certificate rejections"
+
+
+def load_ledger(res):
+    named = res.results_dir / f"solve_ledger.{res.rid}.json"
+    path = named if named.exists() else res.results_dir / "solve_ledger.json"
+    return json.loads(path.read_text())
+
+
+def main() -> int:
+    import tempfile
+
+    from dervet_tpu.service import FleetRouter
+
+    workdir = Path(tempfile.mkdtemp(prefix="reqcache-smoke-"))
+    report = {"requests": N_REQ, "duplicates": N_DUP}
+    root = workdir / "fleet"
+    root.mkdir()
+    reps = spawn_fleet(root, 2, "r")
+    router = FleetRouter(reps, fleet_dir=root / "router",
+                         heartbeat_timeout_s=5.0, tick_s=0.05).start()
+
+    # ---- wave A: cold solves ----------------------------------------
+    log(f"wave A: {N_REQ} cold solves …")
+    t0 = time.time()
+    results_a = collect(route_wave(router, workload()))
+    report["cold_wall_s"] = round(time.time() - t0, 1)
+    cold_lat = sorted(r.latency_s for r in results_a.values())
+    a_csvs = {}
+    for rid, res in results_a.items():
+        assert not res.cached, f"{rid}: cold solve flagged cached"
+        assert_certified(rid, res)
+        a_csvs[rid] = csv_surface(res.results_dir)
+        assert a_csvs[rid], f"{rid}: empty CSV surface"
+    rids_after_a = replica_rids(reps)
+    log(f"wave A done in {report['cold_wall_s']}s")
+
+    # ---- wave B: identical content, fresh ids → pure cache hits -----
+    log("wave B: repeat wave (cache hits) …")
+    results_b = collect(route_wave(router, workload(), rid_prefix="w2."))
+    hit_lat = sorted(r.latency_s for r in results_b.values())
+    for rid, res in results_b.items():
+        assert res.cached, f"{rid}: repeat request missed the cache"
+        assert res.replica == "request_cache", (rid, res.replica)
+        assert_certified(rid, res)
+        got = csv_surface(res.results_dir)
+        ref = a_csvs[rid[len("w2."):]]
+        assert sorted(got) == sorted(ref), \
+            f"{rid}: cached CSV file set differs"
+        for name in ref:
+            assert got[name] == ref[name], \
+                f"{rid}/{name}: cached bytes differ from cold solve"
+    # ZERO replica dispatches: no wave-B rid ever reached a replica
+    leaked = replica_rids(reps) - rids_after_a
+    assert not (leaked & set(results_b)), \
+        f"cache-hit rids reached a replica: {sorted(leaked)}"
+    m = router.metrics()["routing"]
+    assert m["request_cache_hits"] == N_REQ, m
+    assert m["request_cache_stores"] >= N_REQ, m
+    cold_p50 = cold_lat[len(cold_lat) // 2]
+    hit_p50 = hit_lat[len(hit_lat) // 2]
+    assert hit_p50 < 0.2 * cold_p50, \
+        f"hit p50 {hit_p50:.3f}s not << cold p50 {cold_p50:.3f}s"
+    report.update({
+        "cold_p50_s": round(cold_p50, 3), "hit_p50_s": round(hit_p50, 4),
+        "hit_speedup": round(cold_p50 / max(hit_p50, 1e-9), 1),
+    })
+    log(f"wave B: {N_REQ}/{N_REQ} hits, p50 {hit_p50 * 1e3:.0f}ms "
+        f"vs cold {cold_p50:.1f}s")
+
+    # ---- dedup: N identical co-pending requests → one solve ---------
+    log(f"dedup: {N_DUP} identical co-pending …")
+    from dervet_tpu.benchlib import synthetic_sensitivity_cases
+    dup_case = {0: synthetic_sensitivity_cases(1, n=60, months=1)[0]}
+    dup_futs = {f"dup{i}": router.submit(
+                    copy.deepcopy(dup_case), request_id=f"dup{i}",
+                    deadline_s=DEADLINE_S)
+                for i in range(N_DUP)}
+    dup_results = collect(dup_futs)
+    dispatched = replica_rids(reps) & set(dup_futs)
+    assert len(dispatched) == 1, \
+        f"dedup leaked {len(dispatched)} dispatches: {sorted(dispatched)}"
+    coalesced = [rid for rid, r in dup_results.items() if r.coalesced]
+    assert len(coalesced) == N_DUP - 1, (coalesced, N_DUP)
+    m = router.metrics()["routing"]
+    assert m["duplicates_coalesced"] == N_DUP - 1, m
+    base_surface = None
+    for rid, res in dup_results.items():
+        assert_certified(rid, res)
+        got = csv_surface(res.results_dir)
+        if base_surface is None:
+            base_surface = got
+        assert got == base_surface, f"{rid}: coalesced bytes differ"
+    # exactly-once delivery surface: every rid journaled individually
+    events = [json.loads(ln) for ln in
+              (root / "router" /
+               "fleet_journal.jsonl").read_text().splitlines()]
+    done = {e["rid"] for e in events if e["event"] == "completed"}
+    assert set(dup_futs) <= done, sorted(set(dup_futs) - done)
+    report["duplicates_coalesced"] = len(coalesced)
+    log(f"dedup: 1 solve for {N_DUP} requests "
+        f"({len(coalesced)} coalesced)")
+
+    # ---- delta: one-window edit, cpu byte-identity ------------------
+    log("delta: base solve, then a one-window edit …")
+    base = delta_base_case()
+    res_base = router.submit(copy.deepcopy(base), request_id="delta.base",
+                             deadline_s=DEADLINE_S).result(timeout=900)
+    assert_certified("delta.base", res_base)
+    edited = edit_one_window(base)
+    res_delta = router.submit_delta(
+        base, copy.deepcopy(edited), request_id="delta.edit",
+        deadline_s=DEADLINE_S).result(timeout=900)
+    assert_certified("delta.edit", res_delta)
+    events = [json.loads(ln) for ln in
+              (root / "router" /
+               "fleet_journal.jsonl").read_text().splitlines()]
+    note = [e for e in events if e["event"] == "delta"
+            and e["rid"] == "delta.edit"]
+    assert note and note[0]["windows_changed"] == 1, note
+    total = note[0]["windows_total"]
+    m = router.metrics()["routing"]
+    assert m["delta_requests"] == 1, m
+    report.update({"delta_windows_total": total,
+                   "delta_windows_changed": 1})
+    log(f"delta: diff localized to 1/{total} windows")
+
+    # merged answer byte-identical to a full cold re-solve of the
+    # edited case on a FRESH fleet (cpu backend contract)
+    log("delta: cold re-solve reference …")
+    cold_root = workdir / "coldref"
+    cold_root.mkdir()
+    cold_reps = spawn_fleet(cold_root, 1, "c")
+    cold_router = FleetRouter(cold_reps, fleet_dir=cold_root / "router",
+                              heartbeat_timeout_s=5.0).start()
+    try:
+        res_cold = cold_router.submit(
+            copy.deepcopy(edited), request_id="delta.cold",
+            deadline_s=DEADLINE_S).result(timeout=900)
+        got = csv_surface(res_delta.results_dir)
+        ref = csv_surface(res_cold.results_dir)
+        assert sorted(got) == sorted(ref) and got, \
+            "delta CSV file set differs from cold re-solve"
+        for name in ref:
+            assert got[name] == ref[name], \
+                f"delta/{name}: bytes differ from full cold re-solve"
+    finally:
+        cold_router.close()
+    report["delta_byte_identical"] = True
+    log("delta: byte-identical to the cold re-solve")
+    router.close()
+
+    # ---- delta warm plane: only the changed window re-solves --------
+    # the warm-start memory (exact substitution) lives on the batched
+    # jax path, so this drill runs one jax replica (pinned to CPU XLA):
+    # the delta's ledger must show every unchanged window shipped from
+    # the base solve's stored solutions
+    log("delta warm plane: jax replica …")
+    from dervet_tpu.service import spawn_replica
+    jax_root = workdir / "jaxdelta"
+    jax_root.mkdir()
+    jlog = open(jax_root / "j0.log", "w")
+    jrep = spawn_replica(jax_root / "j0", name="j0", backend="jax",
+                         stdout=jlog, stderr=jlog)
+    jrouter = FleetRouter([jrep], fleet_dir=jax_root / "router",
+                          heartbeat_timeout_s=5.0).start()
+    try:
+        jbase = delta_base_case(days=10)
+        jres = jrouter.submit(copy.deepcopy(jbase),
+                              request_id="jd.base",
+                              deadline_s=DEADLINE_S).result(timeout=900)
+        assert_certified("jd.base", jres)
+        jedited = edit_one_window(jbase)
+        jres_d = jrouter.submit_delta(
+            jbase, jedited, request_id="jd.edit",
+            deadline_s=DEADLINE_S).result(timeout=900)
+        assert_certified("jd.edit", jres_d)
+        jledger = load_ledger(jres_d)
+        jtotal = int(jledger["totals"]["windows"])
+        # the per-request ledger slice carries warm accounting per
+        # group (initial rungs), not the run-level warm_start rollup
+        substituted = sum(
+            int((g.get("warm") or {}).get("substituted") or 0)
+            for g in jledger.get("groups", [])
+            if g.get("rung") in (None, "initial"))
+        assert substituted >= jtotal - 2, \
+            f"delta re-solved too much: {substituted}/{jtotal} " \
+            "windows substituted for a 1-window edit"
+    finally:
+        jrouter.close()
+    report.update({"delta_jax_windows": jtotal,
+                   "delta_jax_substituted": substituted})
+    log(f"delta warm plane: {substituted}/{jtotal} windows "
+        "exact-substituted (1-window edit)")
+
+    # ---- kill switch: plain path, bit for bit, zero cache files -----
+    log("kill switch: DERVET_TPU_REQUEST_CACHE=0 …")
+    os.environ["DERVET_TPU_REQUEST_CACHE"] = "0"
+    try:
+        off_reps = spawn_fleet(root, 2, "k")
+        off_router = FleetRouter(off_reps, fleet_dir=root / "router_off",
+                                 heartbeat_timeout_s=5.0,
+                                 tick_s=0.05).start()
+        try:
+            off_a = collect(route_wave(off_router, workload(),
+                                       rid_prefix="off."))
+            off_b = collect(route_wave(off_router, workload(),
+                                       rid_prefix="off2."))
+            seen = replica_rids(off_reps)
+            for rid, res in {**off_a, **off_b}.items():
+                assert not res.cached and not res.coalesced, rid
+                assert rid in seen, \
+                    f"{rid}: never reached a replica with the cache off"
+                assert_certified(rid, res)
+                ref = a_csvs[rid.split(".", 1)[1]]
+                got = csv_surface(res.results_dir)
+                for name in ref:
+                    assert got[name] == ref[name], \
+                        f"{rid}/{name}: kill-switch bytes differ"
+            c = off_router.metrics()["routing"]
+            assert c["request_cache_hits"] == 0, c
+            assert c["request_cache_stores"] == 0, c
+            assert c["duplicates_coalesced"] == 0, c
+            cache_dirs = [p for p in (root / "router_off").rglob("*")
+                          if "result_cache" in p.name]
+            assert not cache_dirs, \
+                f"kill switch left cache files: {cache_dirs}"
+        finally:
+            off_router.close()
+    finally:
+        del os.environ["DERVET_TPU_REQUEST_CACHE"]
+    report["kill_switch_byte_identical"] = True
+    log("kill switch: plain path bit for bit, zero cache files")
+
+    report["ok"] = True
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -376,6 +376,140 @@ class TestFleetShardedRounds:
             svc.close()
 
 
+class TestShardCaseCache:
+    """ROADMAP 1a closed: the full site payload ships once; later dual
+    rounds ship a reference (price + plan fingerprint) resolved against
+    the replica's bounded case cache, and a cold replica's typed miss
+    triggers exactly one full-payload reseed."""
+
+    def _service(self):
+        from dervet_tpu.service.server import ScenarioService
+        svc = ScenarioService(backend="cpu", max_wait_s=0.0)
+        svc.start()
+        return svc
+
+    def test_reference_resolves_after_seed(self):
+        svc = self._service()
+        try:
+            m = _members(2)
+            full = {"sites": m, "price": np.zeros(48), "seed_tag": "t",
+                    "plan_fp": "fp1", "shard": 0, "round": 0,
+                    "backend": "cpu", "solver_opts": None}
+            res = svc.submit_portfolio_shard(full).result(timeout=300)
+            ref = {k: v for k, v in full.items() if k != "sites"}
+            ref["round"] = 1
+            res2 = svc.submit_portfolio_shard(ref).result(timeout=300)
+            assert set(res2.outcomes) == set(res.outcomes)
+        finally:
+            svc.close()
+
+    def test_cold_reference_raises_typed_miss(self):
+        from dervet_tpu.utils.errors import ShardCacheMissError
+        svc = self._service()
+        try:
+            with pytest.raises(ShardCacheMissError):
+                svc.submit_portfolio_shard(
+                    {"price": np.zeros(48), "seed_tag": "t",
+                     "plan_fp": "never-seeded", "shard": 0,
+                     "round": 1, "backend": "cpu",
+                     "solver_opts": None})
+        finally:
+            svc.close()
+
+    def test_plan_fp_mismatch_misses(self):
+        # same seed_tag, DIFFERENT content fingerprint: the cache must
+        # never resolve a stale site set for an edited portfolio
+        from dervet_tpu.utils.errors import ShardCacheMissError
+        svc = self._service()
+        try:
+            m = _members(2)
+            svc.submit_portfolio_shard(
+                {"sites": m, "price": np.zeros(48), "seed_tag": "t",
+                 "plan_fp": "fp1", "shard": 0, "round": 0,
+                 "backend": "cpu",
+                 "solver_opts": None}).result(timeout=300)
+            with pytest.raises(ShardCacheMissError):
+                svc.submit_portfolio_shard(
+                    {"price": np.zeros(48), "seed_tag": "t",
+                     "plan_fp": "fp2-edited", "shard": 0, "round": 1,
+                     "backend": "cpu", "solver_opts": None})
+        finally:
+            svc.close()
+
+    def test_cache_is_bounded_lru(self):
+        from dervet_tpu.utils.errors import ShardCacheMissError
+        svc = self._service()
+        svc._shard_cases_cap = 1
+        try:
+            m = _members(2)
+            base = {"price": np.zeros(48), "shard": 0, "round": 0,
+                    "backend": "cpu", "solver_opts": None}
+            svc.submit_portfolio_shard(
+                {**base, "sites": m, "seed_tag": "a",
+                 "plan_fp": "fa"}).result(timeout=300)
+            svc.submit_portfolio_shard(
+                {**base, "sites": m, "seed_tag": "b",
+                 "plan_fp": "fb"}).result(timeout=300)
+            # "a" evicted by the 1-entry cap: its reference must miss
+            with pytest.raises(ShardCacheMissError):
+                svc.submit_portfolio_shard(
+                    {**base, "seed_tag": "a", "plan_fp": "fa",
+                     "round": 1})
+        finally:
+            svc.close()
+
+    def test_executor_ref_rounds_and_miss_reseed(self):
+        """End-to-end executor protocol: round 0 ships full payloads,
+        round 1 ships references at a fraction of the bytes, and an
+        evicted replica cache (cold after failover/restart) triggers a
+        one-shot full reseed that restores the round."""
+        from dervet_tpu.portfolio.shard import FleetShardExecutor
+        from dervet_tpu.service.fleet import LocalReplica
+        from dervet_tpu.service.router import FleetRouter
+        from dervet_tpu.service.server import ScenarioService
+        services = [ScenarioService(backend="cpu", max_wait_s=0.0)
+                    for _ in range(2)]
+        for s in services:
+            s.start()
+        reps = [LocalReplica(f"n{i}", s)
+                for i, s in enumerate(services)]
+        router = FleetRouter(reps, heartbeat_timeout_s=5.0,
+                             hedging=False).start()
+        try:
+            m = _members(4)
+            keys = sorted(m, key=str)
+            ex = FleetShardExecutor(
+                m, [keys[:2], keys[2:]], router, backend="cpu",
+                portfolio_id="pfc", deadline_s=300.0)
+            assert all(fp is not None for fp in ex.plan_fps)
+            price = np.zeros(48)
+            r0 = ex.dispatch_round(price, 0)
+            r1 = ex.dispatch_round(price, 1)
+            assert all(not rec["ref_mode"] for rec in r0.shard_records)
+            assert all(rec["ref_mode"] for rec in r1.shard_records)
+            # the remainder's point: a reference round ships a small
+            # fraction of the full payload's bytes
+            assert ex.wire_bytes_rounds[1] < 0.2 * ex.wire_bytes_rounds[0]
+            assert set(r1.outcomes) == set(r0.outcomes) == set(map(str,
+                                                                   keys))
+            # evict every replica's case cache (what a restart or a
+            # failover-moved shard looks like), then round 2 reseeds
+            for svc in services:
+                with svc._shard_cases_lock:
+                    svc._shard_cases.clear()
+            r2 = ex.dispatch_round(price, 2)
+            assert set(r2.outcomes) == set(r0.outcomes)
+            assert ex.wire_bytes_rounds[2] > ex.wire_bytes_rounds[1]
+            # and the NEXT round is back to cheap references
+            r3 = ex.dispatch_round(price, 3)
+            assert all(rec["ref_mode"] for rec in r3.shard_records)
+            assert ex.wire_bytes_rounds[3] < 0.2 * ex.wire_bytes_rounds[2]
+        finally:
+            router.close(terminate_replicas=False)
+            for s in services:
+                s.close()
+
+
 # ---------------------------------------------------------------------------
 # dual_iterate hints ride the fleet memory handoff
 # ---------------------------------------------------------------------------
