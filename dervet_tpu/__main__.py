@@ -33,6 +33,13 @@ def main(argv=None):
         # 75 preempted, 2 infeasible)
         from .portfolio.cli import portfolio_main
         raise SystemExit(portfolio_main(argv[1:]))
+    if argv and argv[0] == "fleet":
+        # supervised multi-replica fleet: spawn N serve replicas behind
+        # a FleetRouter with the lifecycle supervisor attached (crash
+        # respawn with backoff, quarantine, telemetry-driven
+        # autoscaling); runs until SIGTERM/SIGINT
+        from .service.lifecycle import fleet_main
+        raise SystemExit(fleet_main(argv[1:]))
     if argv and argv[0] == "status":
         # live fleet health from replica-published telemetry expositions
         # (telemetry/ops.py): replicas, breakers, queue depths, warm hit

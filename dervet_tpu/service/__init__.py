@@ -9,11 +9,13 @@ frontier; engine in ``dervet_tpu.design``, integration in
 ``router.py``: N serve-loop replicas behind a ``FleetRouter`` with
 structure-affinity routing, health-probed failover, and exactly-once
 recovery of a dead replica's in-flight requests)."""
-from ..utils.errors import FleetUnavailableError, ReplicaAnswerError
+from ..utils.errors import (FleetUnavailableError, ReplicaAnswerError,
+                            ReplicaQuarantinedError)
 from .client import ScenarioClient
 from .fleet import (LocalReplica, ReplicaHandle, SpoolReplica,
                     spawn_replica, structure_fingerprint)
 from .journal import ServiceJournal
+from .lifecycle import FleetSupervisor, ReplicaSpec, supervision_enabled
 from .queue import (AdmissionQueue, BreakerOpenError, DeadlineExpiredError,
                     PoisonRequestError, QueueFullError, RequestFailedError,
                     RequestPreemptedError, ServiceClosedError, ServiceError)
@@ -22,11 +24,12 @@ from .server import ScenarioService, serve_main
 
 __all__ = [
     "AdmissionQueue", "BreakerOpenError", "DeadlineExpiredError",
-    "FleetRouter", "FleetUnavailableError", "LocalReplica",
-    "PoisonRequestError", "QueueFullError", "ReplicaAnswerError",
-    "ReplicaHandle", "RequestFailedError", "RequestPreemptedError",
+    "FleetRouter", "FleetSupervisor", "FleetUnavailableError",
+    "LocalReplica", "PoisonRequestError", "QueueFullError",
+    "ReplicaAnswerError", "ReplicaHandle", "ReplicaQuarantinedError",
+    "ReplicaSpec", "RequestFailedError", "RequestPreemptedError",
     "RoutedResult", "ScenarioClient", "ScenarioService",
     "ServiceClosedError", "ServiceError", "ServiceJournal",
     "SpoolReplica", "serve_main", "spawn_replica",
-    "structure_fingerprint",
+    "structure_fingerprint", "supervision_enabled",
 ]

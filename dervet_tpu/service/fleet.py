@@ -102,6 +102,20 @@ class ReplicaHandle:
     def __init__(self, name: str):
         self.name = str(name)
         self.state = "up"
+        # heartbeat-epoch fence (lifecycle supervisor): ``epoch`` is the
+        # incarnation this handle's process was spawned with (None =
+        # unsupervised, no fencing); ``fence_epoch`` is set by the
+        # router at declare-dead time to the LAST epoch it saw beat —
+        # only a beat with a STRICTLY HIGHER epoch can resurrect the
+        # name, so a fenced zombie's late heartbeat writes over the
+        # shared spool can never re-open routing to the corpse
+        self.epoch: Optional[int] = None
+        self.fence_epoch: Optional[int] = None
+        # restart metadata (stamped by the supervisor on each respawn;
+        # surfaced through snapshot() -> metrics -> `dervet-tpu status`)
+        self.restarts = 0
+        self.last_restart_reason: Optional[str] = None
+        self.last_restart_t: Optional[float] = None
 
     # -- request path ---------------------------------------------------
     def submit(self, cases, rid: str, *, priority: int = 0,
@@ -407,7 +421,10 @@ class SpoolReplica(ReplicaHandle):
         return {"name": self.name, "state": self.state,
                 "spool": str(self.spool),
                 "pid": self.process.pid if self.process else None,
-                "process_alive": alive}
+                "process_alive": alive,
+                "epoch": self.epoch,
+                "restarts": self.restarts,
+                "last_restart_reason": self.last_restart_reason}
 
 
 class LocalReplica(ReplicaHandle):
@@ -516,6 +533,7 @@ def spawn_replica(spool, *, name: Optional[str] = None,
                   backend: str = "cpu", heartbeat_s: float = 0.25,
                   poll_s: float = 0.05, max_queue_depth: int = 64,
                   force_cpu_platform: bool = True,
+                  epoch: Optional[int] = None,
                   extra_args: Optional[List[str]] = None,
                   env: Optional[Dict[str, str]] = None,
                   stdout=subprocess.DEVNULL,
@@ -528,7 +546,13 @@ def spawn_replica(spool, *, name: Optional[str] = None,
     ``jax.config`` before any dervet import (the env-var route is too
     late on hosts whose sitecustomize pre-imports jax) — fleet drills
     and CI replicas are CPU-deterministic by design; a real accelerator
-    fleet passes ``force_cpu_platform=False`` and its own env."""
+    fleet passes ``force_cpu_platform=False`` and its own env.
+
+    ``epoch`` is the incarnation number the lifecycle supervisor bumps
+    on every respawn over a reused spool: the child stamps it into each
+    heartbeat, and the router only credits beats at or above the
+    handle's epoch — a fenced zombie still writing the old spool can
+    never impersonate its replacement."""
     spool = Path(spool)
     spool.mkdir(parents=True, exist_ok=True)
     # a reused spool's previous-incarnation heartbeat must not be read
@@ -542,7 +566,9 @@ def spawn_replica(spool, *, name: Optional[str] = None,
     argv = [str(spool), "--backend", backend,
             "--poll-s", str(poll_s), "--heartbeat-s", str(heartbeat_s),
             "--max-queue-depth", str(max_queue_depth),
-            "--replica-name", name] + list(extra_args or [])
+            "--replica-name", name] + \
+        (["--heartbeat-epoch", str(int(epoch))]
+         if epoch is not None else []) + list(extra_args or [])
     preamble = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
                 if force_cpu_platform else "")
     code = (f"import sys, json; {preamble}"
@@ -559,4 +585,7 @@ def spawn_replica(spool, *, name: Optional[str] = None,
     child_env.update(env or {})
     proc = subprocess.Popen([sys.executable, "-c", code], env=child_env,
                             stdout=stdout, stderr=stderr)
-    return SpoolReplica(name, spool, process=proc)
+    handle = SpoolReplica(name, spool, process=proc)
+    if epoch is not None:
+        handle.epoch = int(epoch)
+    return handle

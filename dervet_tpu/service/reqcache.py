@@ -44,6 +44,11 @@ Three pieces, all consumed by :class:`~.router.FleetRouter`:
 
 ``DERVET_TPU_REQUEST_CACHE=0`` kills the whole plane: no lookups, no
 stores, no dedup keys, no on-disk state — today's path bit for bit.
+Cache hygiene (ROADMAP 3(d) starter) is env-tunable:
+``DERVET_TPU_REQUEST_CACHE_TTL_S`` ages entries out at lookup time
+(default: no TTL — LRU only), ``DERVET_TPU_REQUEST_CACHE_MAX_ENTRIES``
+overrides the LRU capacity; eviction/expiry counts ride the router's
+fleet telemetry exposition.
 """
 from __future__ import annotations
 
@@ -53,6 +58,7 @@ import os
 import pickle
 import shutil
 import threading
+import time
 import weakref
 from collections import OrderedDict
 from pathlib import Path
@@ -61,6 +67,19 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 ENV = "DERVET_TPU_REQUEST_CACHE"
+TTL_ENV = "DERVET_TPU_REQUEST_CACHE_TTL_S"
+MAX_ENTRIES_ENV = "DERVET_TPU_REQUEST_CACHE_MAX_ENTRIES"
+
+
+def _env_positive_float(name: str) -> Optional[float]:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        return None
+    return v if v > 0 else None
 
 
 def current_solver_version() -> str:
@@ -274,13 +293,20 @@ class RequestResultCache:
     dir itself is created lazily on the first store — with the kill
     switch on, no cache files OR dirs ever appear."""
 
-    def __init__(self, root, max_entries: int = 256):
+    def __init__(self, root, max_entries: int = 256,
+                 ttl_s: Optional[float] = None):
         self.root = Path(root)
-        self.max_entries = int(max_entries)
+        # env knobs win over constructor defaults so a deployment can
+        # retune cache hygiene without touching router construction
+        env_max = _env_positive_float(MAX_ENTRIES_ENV)
+        self.max_entries = (int(env_max) if env_max is not None
+                            else int(max_entries))
+        self.ttl_s = (ttl_s if ttl_s is not None
+                      else _env_positive_float(TTL_ENV))
         self._lock = threading.Lock()
         self._entries: "OrderedDict[str, Dict]" = OrderedDict()
         self._counters = {"hits": 0, "misses": 0, "stores": 0,
-                          "evictions": 0, "refused": 0,
+                          "evictions": 0, "expired": 0, "refused": 0,
                           "collisions": 0, "invalidations": 0}
         self._load()
 
@@ -298,7 +324,11 @@ class RequestResultCache:
             ef = d / ENTRY_FILE
             try:
                 entry = json.loads(ef.read_text())
-                found.append((ef.stat().st_mtime, d.name, entry))
+                mtime = ef.stat().st_mtime
+                # pre-TTL entries carry no store time: the entry file's
+                # mtime is exactly when the store landed
+                entry.setdefault("t", mtime)
+                found.append((mtime, d.name, entry))
             except (OSError, ValueError):
                 continue
         for _, key, entry in sorted(found):
@@ -317,6 +347,14 @@ class RequestResultCache:
             entry = self._entries.get(key)
             if entry is None:
                 self._counters["misses"] += 1
+                return None
+            if self.ttl_s is not None and \
+                    time.time() - float(entry.get("t") or 0) > self.ttl_s:
+                # aged out: drop memory + disk, count, miss
+                self._entries.pop(key, None)
+                self._counters["expired"] += 1
+                self._counters["misses"] += 1
+                shutil.rmtree(self._entry_dir(key), ignore_errors=True)
                 return None
             if entry.get("material") != material:
                 self._counters["collisions"] += 1
@@ -358,7 +396,8 @@ class RequestResultCache:
             return False
         entry = {"key": key, "material": material, "rid": str(rid),
                  "kind": "dir" if results_dir is not None else "pickle",
-                 "solver_version": material.get("solver_version")}
+                 "solver_version": material.get("solver_version"),
+                 "t": round(time.time(), 3)}
         tmp = self.root / f".tmp.{key[:16]}.{os.getpid()}"
         try:
             self.root.mkdir(parents=True, exist_ok=True)
@@ -422,13 +461,15 @@ class RequestResultCache:
         with self._lock:
             return {"entries": len(self._entries),
                     "max_entries": self.max_entries,
+                    "ttl_s": self.ttl_s,
                     **self._counters}
 
 
-def open_cache(root, max_entries: int = 256) -> RequestResultCache:
+def open_cache(root, max_entries: int = 256,
+               ttl_s: Optional[float] = None) -> RequestResultCache:
     """Construct + register a cache with the process-wide invalidation
     registry (so PR-4 rejections reach it)."""
-    cache = RequestResultCache(root, max_entries=max_entries)
+    cache = RequestResultCache(root, max_entries=max_entries, ttl_s=ttl_s)
     _LIVE_CACHES.add(cache)
     return cache
 

@@ -291,6 +291,16 @@ class FleetRouter:
         self._telemetry_last = 0.0
         self._seq = 0
         self._t_start = time.monotonic()
+        # when each replica entered THIS router's care: the startup
+        # grace window is measured from here, so a replacement the
+        # lifecycle supervisor adopts hours into the router's life
+        # still gets its full boot grace before staleness can kill it
+        self._adopted_at: Dict[str, float] = {
+            n: self._t_start for n in self.replicas}
+        # lifecycle supervisor hook (service/lifecycle.py): when
+        # attached, _declare_dead hands it the corpse after fencing +
+        # failover, and it respawns/quarantines per its policy
+        self.supervisor = None
         self._counters = {
             "submitted": 0, "completed": 0, "failed": 0,
             "affinity_hits": 0, "affinity_misses": 0, "redirects": 0,
@@ -316,6 +326,75 @@ class FleetRouter:
                 name="dervet-fleet-monitor")
             self._monitor.start()
         return self
+
+    def attach_supervisor(self, supervisor) -> None:
+        """Register the fleet lifecycle supervisor: ``_declare_dead``
+        hands it every corpse (after fencing + exactly-once failover),
+        and it respawns/quarantines/autoscales per its policy."""
+        self.supervisor = supervisor
+
+    def adopt_replica(self, handle: ReplicaHandle) -> None:
+        """Register a replica handle under this router — a supervisor
+        respawn replacing a dead handle of the same name, or a
+        scale-up adding a new one.  All per-replica routing/health
+        bookkeeping is (re)initialized; health state starts clean with
+        a fresh startup-grace window.  The breaker is NOT reset: a
+        replacement earns routing back through the probe cycle once it
+        beats with its fresh epoch."""
+        with self._lock:
+            name = handle.name
+            replacing = name in self.replicas
+            self.replicas[name] = handle
+            if not replacing:
+                self._inflight[name] = 0
+                self._completions[name] = deque(maxlen=32)
+            # a replacement must re-prove liveness from scratch: its
+            # predecessor's last beat/publication is not its own
+            self._first_seen[name] = None
+            self._last_beat[name] = None
+            self._hb_cache[name] = None
+            self._pub_load[name] = None
+            self._adopted_at[name] = time.monotonic()
+        if self.journal is not None:
+            self.journal.note("replica_adopted", name,
+                              epoch=handle.epoch,
+                              replaced=replacing)
+
+    def remove_replica(self, name: str) -> bool:
+        """Deregister one replica (supervisor scale-down after a clean
+        drain).  Refused while the replica still has live routes —
+        the caller must drain first."""
+        with self._lock:
+            h = self.replicas.get(name)
+            if h is None:
+                return False
+            if any(r.replica == name for p in self._pending.values()
+                   for r in p.live_routes()):
+                return False
+            self.replicas.pop(name, None)
+            for d in (self._inflight, self._completions,
+                      self._first_seen, self._last_beat, self._hb_cache,
+                      self._pub_load, self._adopted_at):
+                d.pop(name, None)
+            self._probes.pop(name, None)
+            # drop stale affinity pins so new requests re-rank instead
+            # of chasing a removed name
+            for fp in [fp for fp, n in self._affinity.items()
+                       if n == name]:
+                self._affinity.pop(fp, None)
+        if self.journal is not None:
+            self.journal.note("replica_removed", name)
+        return True
+
+    def load_snapshot(self) -> Dict[str, Dict]:
+        """Per-replica load view for the lifecycle supervisor's
+        autoscaler: the scraped self-published signal plus this
+        router's own inflight count and liveness state."""
+        with self._lock:
+            return {name: {"state": h.state,
+                           "inflight": self._inflight.get(name, 0),
+                           "published": self._pub_load.get(name)}
+                    for name, h in self.replicas.items()}
 
     def close(self, terminate_replicas: bool = True) -> None:
         with self._lock:
@@ -679,8 +758,13 @@ class FleetRouter:
         """Routable replica names: up, not draining, breaker not open.
         Caller holds the lock."""
         out = []
-        for name, h in self.replicas.items():
+        for name, h in list(self.replicas.items()):
             if name in exclude or h.state == "dead":
+                continue
+            # lifecycle scale-down: the supervisor marks the victim
+            # draining BEFORE its process is told to drain, so no new
+            # route can land in the SIGTERM window
+            if getattr(h, "draining", False):
                 continue
             if self.breakers.is_open(name):
                 continue
@@ -871,7 +955,7 @@ class FleetRouter:
         if now - self._scrape_last < 0.25:
             return
         self._scrape_last = now
-        for name, h in self.replicas.items():
+        for name, h in list(self.replicas.items()):
             if h.state == "dead":
                 continue
             try:
@@ -902,7 +986,7 @@ class FleetRouter:
             pub_load = dict(self._pub_load)
         for k, v in counters.items():
             reg.gauge(f"dervet_fleet_{k}").set(float(v))
-        for name, h in self.replicas.items():
+        for name, h in list(self.replicas.items()):
             reg.gauge("dervet_fleet_replica_up", replica=name).set(
                 0.0 if h.state == "dead" else 1.0)
             reg.gauge("dervet_fleet_inflight", replica=name).set(
@@ -915,6 +999,16 @@ class FleetRouter:
                 reg.gauge("dervet_fleet_published_drain_rate_rps",
                           replica=name).set(
                     float(pub.get("drain_rate_rps") or 0.0))
+        if self.result_cache is not None:
+            # cache-hygiene counters (reqcache TTL/LRU eviction knobs)
+            # ride the same exposition the autoscaler and `status` read
+            snap = self.result_cache.snapshot()
+            reg.gauge("dervet_request_cache_entries").set(
+                float(snap["entries"]))
+            for k in ("hits", "misses", "stores", "evictions",
+                      "expired"):
+                reg.gauge(f"dervet_request_cache_{k}_total").set(
+                    float(snap[k]))
         reg.sample()
         try:
             from ..telemetry.ops import FLEET_PROM_FILE
@@ -1200,8 +1294,20 @@ class FleetRouter:
     # -- health / failover ----------------------------------------------
     def _check_health(self) -> None:
         now = time.time()
-        for name, h in self.replicas.items():
+        for name, h in list(self.replicas.items()):
             hb = h.heartbeat()
+            # heartbeat-epoch fence: a beat carrying an epoch BELOW the
+            # handle's own incarnation — or at/below an armed fence —
+            # is a fenced zombie's late write over the shared spool:
+            # discredit it entirely (it must neither count as liveness
+            # nor echo probes nor resurrect the name)
+            hb_epoch = None if hb is None else hb.get("epoch")
+            if hb_epoch is not None and (
+                    (h.epoch is not None
+                     and int(hb_epoch) < int(h.epoch))
+                    or (h.fence_epoch is not None
+                        and int(hb_epoch) <= int(h.fence_epoch))):
+                hb = None
             self._hb_cache[name] = hb
             fresh = (hb is not None
                      and now - float(hb.get("t", 0))
@@ -1217,15 +1323,23 @@ class FleetRouter:
                 # process that died, a fresh beat can only come from a
                 # NEW process over the same spool — its pid differs, and
                 # the handle stops owning (fencing a process we did not
-                # spawn would be wrong)
+                # spawn would be wrong).  When a fence epoch was
+                # recorded at declare-dead, only a STRICTLY HIGHER
+                # epoch resurrects: the corpse's own late beats (same
+                # epoch) can never re-open routing to a zombie.
                 new_pid = (hb is not None
                            and getattr(h, "process", None) is not None
                            and hb.get("pid") not in
                            (None, h.process.pid))
-                if fresh and (h.alive() is not False or new_pid):
+                epoch_ok = (h.fence_epoch is None
+                            or (hb_epoch is not None
+                                and int(hb_epoch) > int(h.fence_epoch)))
+                if fresh and epoch_ok \
+                        and (h.alive() is not False or new_pid):
                     if new_pid:
                         h.process = None
                     h.state = "up"
+                    h.fence_epoch = None
                     TellUser.warning(
                         f"fleet: replica {name!r} is heartbeating again "
                         "— resurrected (breaker still gates routing)")
@@ -1237,9 +1351,12 @@ class FleetRouter:
             elif self._first_seen[name] is None:
                 # never seen a fresh beat: a stale heartbeat.json in a
                 # REUSED spool must not fence a still-booting replica —
-                # only the startup grace can expire it
-                if time.monotonic() - self._t_start \
-                        > self.startup_grace_s:
+                # only the startup grace can expire it (measured from
+                # when THIS handle entered the router's care, so a
+                # supervisor-adopted replacement gets its full boot
+                # window)
+                if time.monotonic() - self._adopted_at.get(
+                        name, self._t_start) > self.startup_grace_s:
                     dead_reason = ("no fresh heartbeat within the "
                                    f"{self.startup_grace_s:g}s startup "
                                    "grace")
@@ -1336,6 +1453,15 @@ class FleetRouter:
     def _declare_dead(self, name: str, reason: str) -> None:
         h = self.replicas[name]
         h.state = "dead"
+        # arm the epoch fence: the corpse's incarnation (its spawn
+        # epoch, or the last epoch it beat with) is now STALE — only a
+        # replacement beating with a higher epoch resurrects this name
+        last_hb = self._hb_cache.get(name)
+        last_epoch = (last_hb or {}).get("epoch")
+        if last_epoch is None:
+            last_epoch = h.epoch
+        if last_epoch is not None:
+            h.fence_epoch = int(last_epoch)
         with self._lock:
             self._counters["heartbeat_deaths"] += 1
         TellUser.error(f"fleet: replica {name!r} declared DEAD "
@@ -1343,8 +1469,19 @@ class FleetRouter:
                        "requests")
         self.breakers.trip(name, reason)
         if self.journal is not None:
-            self.journal.note("replica_dead", name, reason=reason)
+            self.journal.note("replica_dead", name, reason=reason,
+                              fence_epoch=h.fence_epoch)
         self._failover(name)
+        # hand the corpse to the lifecycle supervisor AFTER fencing +
+        # exactly-once failover: its in-flight work is already re-homed,
+        # so the supervisor only owes the fleet a replacement
+        if self.supervisor is not None:
+            try:
+                self.supervisor.on_replica_dead(name, reason)
+            except Exception as e:      # supervision must never break
+                TellUser.warning(       # the router's own failover
+                    f"fleet: supervisor death hook for {name!r} "
+                    f"failed: {e}")
 
     def _failover(self, name: str) -> None:
         h = self.replicas[name]
@@ -1530,7 +1667,7 @@ class FleetRouter:
         aff_total = counters["affinity_hits"] + counters["affinity_misses"]
         replicas = {}
         now = time.time()
-        for name, h in self.replicas.items():
+        for name, h in list(self.replicas.items()):
             hb = h.heartbeat()
             replicas[name] = {
                 **h.snapshot(),
@@ -1548,8 +1685,15 @@ class FleetRouter:
             }
         pct = (lambda a, q: round(float(np.percentile(a, q)), 4)
                if a.size else None)
+        supervisor = None
+        if self.supervisor is not None:
+            try:
+                supervisor = self.supervisor.snapshot()
+            except Exception:
+                supervisor = None
         return {
             "replicas": replicas,
+            "supervisor": supervisor,
             "routing": {**counters,
                         "pending": pending,
                         "affinity_hit_rate": (

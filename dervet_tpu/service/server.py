@@ -1142,6 +1142,12 @@ def serve_main(argv=None) -> int:
                              "0 disables)")
     parser.add_argument("--replica-name", default=None,
                         help="name this replica reports in heartbeats")
+    parser.add_argument("--heartbeat-epoch", type=int, default=None,
+                        help="incarnation epoch stamped into every "
+                             "heartbeat (the lifecycle supervisor bumps "
+                             "it on each respawn, so the router can "
+                             "tell a replacement's beats from a fenced "
+                             "zombie's late writes over the same spool)")
     parser.add_argument("--memory-export-s", type=float, default=2.0,
                         help="publish the warm-start memory export at "
                              "this cadence when it changed (failover "
@@ -1217,6 +1223,11 @@ def serve_main(argv=None) -> int:
             "t": round(time.time(), 3),
             "pid": os.getpid(),
             "name": args.replica_name,
+            # incarnation fence: a respawned replacement beats with a
+            # HIGHER epoch, so the router can refuse a SIGKILL-survivor
+            # zombie's stale writes over the shared spool
+            **({"epoch": int(args.heartbeat_epoch)}
+               if args.heartbeat_epoch is not None else {}),
             "draining": service.supervisor.stop_requested(),
             "pending": len(pending),
             "queue_depth": service.queue.depth(),

@@ -179,6 +179,26 @@ def fleet_status(dirs: List[Path], slo_s: float = 60.0) -> Dict:
                             if k.startswith("dervet_fleet_")} or None,
             }
             break
+    # lifecycle supervisor view (supervisor_state.json, published by
+    # service/lifecycle.py): per-replica restart counts, crash-loop /
+    # quarantine state, and last restart reason — merged into the
+    # replica rows by name so the table shows WHY a replica vanished,
+    # not just that its heartbeat aged out
+    sup = None
+    for d in dirs:
+        sup = _read_json(Path(d) / "supervisor_state.json")
+        if sup is not None:
+            break
+    if sup is not None:
+        fleet["supervisor"] = {k: v for k, v in sup.items()
+                               if k != "replicas"}
+        by_name = sup.get("replicas") or {}
+        for r in replicas:
+            s = by_name.get(r["name"])
+            if s is not None:
+                r["restarts"] = s.get("restarts")
+                r["lifecycle"] = s.get("state")
+                r["last_restart_reason"] = s.get("last_restart_reason")
     return fleet
 
 
@@ -191,11 +211,16 @@ def _fmt_cell(v, unit: str = "") -> str:
 
 
 def render_status(fleet: Dict) -> str:
+    # supervisor columns only when a supervisor_state.json was found —
+    # an unsupervised fleet's table stays byte-identical to before
+    supervised = fleet.get("supervisor") is not None
     cols = ("name", "state", "age", "queue", "drain/s", "pending",
             "done", "failed", "warm%", "cert%", "p50", "p99", "brk")
+    if supervised:
+        cols = cols + ("restarts", "life", "last restart")
     rows = []
     for r in fleet["replicas"]:
-        rows.append((
+        row = (
             r["name"], r["state"], _fmt_cell(r.get("heartbeat_age_s"), "s"),
             _fmt_cell(r.get("queue_depth")),
             _fmt_cell(r.get("drain_rate_rps")),
@@ -208,7 +233,16 @@ def render_status(fleet: Dict) -> str:
             _fmt_cell(r.get("latency_p50_s"), "s"),
             _fmt_cell(r.get("latency_p99_s"), "s"),
             _fmt_cell(r.get("breakers_open")),
-        ))
+        )
+        if supervised:
+            reason = r.get("last_restart_reason")
+            row = row + (
+                _fmt_cell(r.get("restarts")),
+                _fmt_cell(r.get("lifecycle")),
+                ("-" if not reason else
+                 reason if len(reason) <= 40 else reason[:37] + "..."),
+            )
+        rows.append(row)
     widths = [max(len(str(c)), *(len(str(row[i])) for row in rows))
               if rows else len(str(c)) for i, c in enumerate(cols)]
     lines = [" ".join(str(c).ljust(widths[i])
@@ -237,6 +271,15 @@ def render_status(fleet: Dict) -> str:
             f"harvested {rt.get('harvested')}, hedged "
             f"{rt.get('hedged')}, affinity hit rate "
             f"{rt.get('affinity_hit_rate')}")
+    sup = fleet.get("supervisor")
+    if sup is not None:
+        c = sup.get("counters") or {}
+        lines.append(
+            f"supervisor: restarts {c.get('restarts')}, quarantined "
+            f"{c.get('quarantined')}, scale up/down "
+            f"{c.get('scale_up')}/{c.get('scale_down')}, warm imports "
+            f"{c.get('warm_imports')}, bounds "
+            f"[{sup.get('min_replicas')}, {sup.get('max_replicas')}]")
     return "\n".join(lines)
 
 

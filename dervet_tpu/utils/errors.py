@@ -232,6 +232,31 @@ class ShardCacheMissError(ServiceError):
         self.retry_hint = 0.0
 
 
+class ReplicaQuarantinedError(ServiceError):
+    """The fleet lifecycle supervisor gave up on a crash-looping
+    replica: it died ``crashes`` times in rapid succession (each within
+    the rapid-crash window of the previous respawn), so instead of
+    hot-looping spawn/crash forever the replica is parked in the typed
+    ``quarantined`` terminal state.  An operator (or a config fix) must
+    clear it via ``FleetSupervisor.release``; ``retry_hint`` is None
+    because respawning the identical replica cannot help."""
+
+    kind = "replica_quarantined"
+
+    def __init__(self, msg: str, replica: Optional[str] = None,
+                 crashes: int = 0, last_reason: Optional[str] = None):
+        super().__init__(msg)
+        self.replica = replica
+        self.crashes = int(crashes)
+        self.last_reason = last_reason
+
+    def as_dict(self) -> Dict:
+        d = super().as_dict()
+        d.update(replica=self.replica, crashes=self.crashes,
+                 last_reason=self.last_reason)
+        return d
+
+
 class ReplicaAnswerError(ServiceError):
     """A spool replica answered the request with a typed failure; the
     router re-raises it on the client future with the replica's

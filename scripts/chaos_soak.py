@@ -34,6 +34,14 @@ Phases:
    subprocess is SIGKILLED mid-spool; the restarted ``--once`` loop
    must journal-recover every request, byte-identical to an
    uninterrupted reference serve.
+4. **supervised** (skippable: ``--skip-supervised``) — a 2-replica
+   fleet under the lifecycle supervisor takes a SEEDED schedule of
+   SIGKILL / SIGSTOP(hang) faults mid-request: zero lost requests,
+   every victim respawned warm (memory import verified) at a bumped
+   heartbeat epoch; a deliberately broken replica spec must crash-loop
+   into the TYPED quarantined terminal state; and with
+   ``DERVET_TPU_FLEET_SUPERVISE=0`` the supervisor must be a complete
+   no-op (today's unsupervised fleet, bit for bit).
 
 Usage (CI runs the first line)::
 
@@ -405,6 +413,210 @@ def run_sigkill_drill(workdir: Path) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Phase 4: the supervised fleet under a seeded fault schedule
+# ---------------------------------------------------------------------------
+
+def _sup_wait(pred, timeout: float, msg: str) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(msg)
+
+
+def run_supervised_drill(workdir: Path, seed: int) -> dict:
+    """Seeded SIGKILL/SIGSTOP schedule against a LIVE supervised fleet:
+    zero lost requests, every victim healed warm at a bumped epoch, the
+    crash-looping spec quarantined with a typed state, and the
+    ``DERVET_TPU_FLEET_SUPERVISE=0`` kill switch a complete no-op."""
+    from dervet_tpu.service import (FleetRouter, FleetSupervisor,
+                                    ReplicaSpec, ServiceJournal)
+
+    rng = random.Random(seed ^ 0x5F1EE7)
+    rounds = 2
+    schedule = [rng.choice(("sigkill", "hang")) for _ in range(rounds)]
+    log(f"supervised: seeded fault schedule {schedule}")
+
+    root = workdir / "supervised"
+    # replicas inherit this process's env (which armed the soak's tight
+    # solve deadline); the per-solve slow fault sleeps OUTSIDE the
+    # solver, but give the children the default generous deadline back
+    env = {"DERVET_TPU_FAULT_SLOW": "all",
+           "DERVET_TPU_FAULT_SLOW_S": "0.4",
+           "DERVET_TPU_SOLVE_DEADLINE_S": "",
+           "DERVET_TPU_REQUEST_CACHE": "0"}
+    specs = [ReplicaSpec(root / f"r{i}", name=f"r{i}", backend="cpu",
+                         env=env) for i in range(2)]
+    router = FleetRouter([], fleet_dir=root / "fleet",
+                         heartbeat_timeout_s=3.0, tick_s=0.05,
+                         breaker_opts={"min_samples": 1,
+                                       "failure_threshold": 0.5,
+                                       "cooldown_s": 1.0}).start()
+    sup = FleetSupervisor(router, specs, backoff_base_s=0.2, tick_s=0.1)
+    assert sup.enabled, "supervision disabled in the soak environment"
+    sup.start()
+    expected_restarts = {"r0": 0, "r1": 0}
+    fired = []
+    delivered = 0
+    try:
+        _sup_wait(lambda: all(sup.snapshot()["replicas"][s.name]["state"]
+                              == "up" for s in specs),
+                  240, "supervised fleet never came up")
+        from dervet_tpu.benchlib import synthetic_sensitivity_cases
+        for rnd, fault in enumerate(schedule):
+            futs = {}
+            for i in range(4):
+                # distinct window lengths per request: distinct LP
+                # structures, so affinity cannot pin the whole round to
+                # one replica and both stay in the fault's blast radius
+                case = synthetic_sensitivity_cases(
+                    1, n=72 + 24 * (4 * rnd + i), months=1)[0]
+                rid = f"sup{rnd}.{i}"
+                futs[rid] = router.submit(
+                    {0: case}, request_id=rid, deadline_s=300.0)
+
+            # the victim is whichever replica is genuinely mid-request
+            # with a warm export to hand off; the seeded order breaks
+            # ties so the drill stays reproducible
+            order = rng.sample(["r0", "r1"], 2)
+            victim_name = None
+
+            def mid_request():
+                nonlocal victim_name
+                for nm in order:
+                    h = router.replicas.get(nm)
+                    if h is None or h.process is None or \
+                            h.alive() is not True:
+                        continue
+                    states = ServiceJournal.replay_path(
+                        h.spool / "service_journal.jsonl")
+                    if any(e["state"] == "admitted"
+                           for e in states.values()) and \
+                            (h.spool / "memory_export.pkl").exists():
+                        victim_name = nm
+                        return True
+                return False
+
+            _sup_wait(mid_request, 240,
+                      f"round {rnd}: no replica mid-request with a "
+                      "warm export — fault window missed")
+            h = router.replicas[victim_name]
+            if fault == "sigkill":
+                h.process.send_signal(signal.SIGKILL)
+            else:
+                os.kill(h.process.pid, signal.SIGSTOP)
+            log(f"supervised round {rnd}: {fault} on {victim_name} "
+                "mid-request")
+            fired.append([victim_name, fault])
+            expected_restarts[victim_name] += 1
+
+            for rid, fut in futs.items():
+                res = fut.result(timeout=600)
+                assert res is not None, f"{rid}: lost"
+                delivered += 1
+
+            want_epoch = 1 + expected_restarts[victim_name]
+
+            def healed():
+                hh = router.replicas.get(victim_name)
+                if hh is None or hh.process is None \
+                        or hh.alive() is not True:
+                    return False
+                rec = sup.snapshot()["replicas"][victim_name]
+                return (rec["state"] == "up"
+                        and rec["restarts"]
+                        >= expected_restarts[victim_name]
+                        and int(hh.epoch or 0) >= want_epoch
+                        and router.metrics()["replicas"][victim_name]
+                        ["breaker"]["state"] == "closed")
+
+            _sup_wait(healed, 240,
+                      f"round {rnd}: {victim_name} never healed")
+            rec = sup.snapshot()["replicas"][victim_name]
+            assert rec["warm_imports"] >= 1, \
+                f"round {rnd}: {victim_name} respawned cold"
+            log(f"supervised round {rnd}: {victim_name} healed "
+                f"(epoch {router.replicas[victim_name].epoch}, "
+                f"warm imports {rec['warm_imports']})")
+
+        m = router.metrics()["routing"]
+        snap = sup.snapshot()
+        assert m["failed"] == 0, m
+        assert m["completed"] == delivered == 4 * rounds, m
+        assert snap["counters"]["restarts"] >= rounds, snap["counters"]
+        assert snap["counters"]["warm_imports"] >= rounds, \
+            snap["counters"]
+        assert snap["counters"]["quarantined"] == 0, snap["counters"]
+    finally:
+        sup.stop()
+        router.close()
+
+    # -- quarantine sub-drill: a spec that can only crash-loop ---------
+    broken_root = workdir / "supervised-broken"
+    broken = ReplicaSpec(broken_root / "bad", name="bad", backend="cpu",
+                         extra_args=["--definitely-not-a-flag"])
+    router2 = FleetRouter([], fleet_dir=broken_root / "fleet",
+                          heartbeat_timeout_s=1.0, tick_s=0.05).start()
+    sup2 = FleetSupervisor(router2, [broken], backoff_base_s=0.05,
+                           backoff_max_s=0.2, rapid_crash_window_s=30.0,
+                           quarantine_after=2, tick_s=0.05)
+    sup2.start()
+    try:
+        _sup_wait(lambda: sup2.snapshot()["replicas"]["bad"]["state"]
+                  == "quarantined", 240, "broken spec never quarantined")
+        q = sup2.snapshot()["replicas"]["bad"]["quarantine"]
+        assert q["kind"] == "replica_quarantined", q
+        assert q["crashes"] >= 2, q
+        n_restarts = sup2.snapshot()["counters"]["restarts"]
+        time.sleep(0.5)
+        assert sup2.snapshot()["counters"]["restarts"] == n_restarts, \
+            "quarantine is not terminal — still respawning"
+        log(f"supervised: broken spec quarantined after {q['crashes']} "
+            "rapid crashes (typed, terminal)")
+    finally:
+        sup2.stop()
+        router2.close()
+
+    # -- kill switch: DERVET_TPU_FLEET_SUPERVISE=0 is a full no-op -----
+    prev = os.environ.get("DERVET_TPU_FLEET_SUPERVISE")
+    os.environ["DERVET_TPU_FLEET_SUPERVISE"] = "0"
+    try:
+        off_root = workdir / "supervised-off"
+        router3 = FleetRouter([], fleet_dir=off_root / "fleet",
+                              tick_s=0.05).start()
+        sup3 = FleetSupervisor(
+            router3, [ReplicaSpec(off_root / "r0", name="r0")])
+        sup3.start()
+        try:
+            assert not sup3.enabled
+            assert router3.supervisor is None, \
+                "kill switch left the supervisor attached"
+            assert sup3._thread is None
+            sup3.on_replica_dead("r0", "crash")
+            time.sleep(0.2)
+            assert "r0" not in router3.replicas, \
+                "kill switch still spawned a replica"
+            assert not (off_root / "fleet" /
+                        "supervisor_state.json").exists(), \
+                "kill switch still published supervisor state"
+        finally:
+            sup3.stop()
+            router3.close()
+    finally:
+        if prev is None:
+            os.environ.pop("DERVET_TPU_FLEET_SUPERVISE", None)
+        else:
+            os.environ["DERVET_TPU_FLEET_SUPERVISE"] = prev
+
+    return {"schedule": schedule, "fired": fired,
+            "delivered": delivered, "lost": 0,
+            "restarts": dict(expected_restarts),
+            "quarantine": {"kind": q["kind"], "crashes": q["crashes"]},
+            "kill_switch_noop": True}
+
+
+# ---------------------------------------------------------------------------
 
 def main() -> int:
     parser = argparse.ArgumentParser(
@@ -415,6 +627,8 @@ def main() -> int:
     parser.add_argument("--skip-sigkill", action="store_true",
                         help="skip the subprocess SIGKILL phase")
     parser.add_argument("--skip-preempt", action="store_true")
+    parser.add_argument("--skip-supervised", action="store_true",
+                        help="skip the supervised-fleet lifecycle phase")
     parser.add_argument("--workdir", default=None,
                         help="scratch dir (default: a fresh tempdir)")
     parser.add_argument("--serve-child", default=None,
@@ -447,6 +661,9 @@ def main() -> int:
     if not args.skip_sigkill:
         log("sigkill drill …")
         report["sigkill"] = run_sigkill_drill(workdir)
+    if not args.skip_supervised:
+        log("supervised-fleet drill …")
+        report["supervised"] = run_supervised_drill(workdir, args.seed)
     report["elapsed_s"] = round(time.time() - t0, 1)
     report["ok"] = True
     print(json.dumps(report))

@@ -1,0 +1,217 @@
+"""CI smoke: the supervised fleet self-heals under SIGKILL + hang.
+
+Boots a 2-replica fleet under :class:`~dervet_tpu.service.lifecycle.
+FleetSupervisor` (real ``dervet-tpu serve`` subprocesses over file
+spools, CPU backend) and runs two drills against it:
+
+* **kill drill** — SIGKILL one replica mid-request.  The router fences
+  and re-routes its in-flight work (exactly-once), then the supervisor
+  respawns the name at a bumped heartbeat epoch with the dead
+  incarnation's warm-start memory imported, and the replacement earns
+  routing back through the breaker's probe cycle.
+* **hang drill** — SIGSTOP the other replica mid-request (heartbeats
+  freeze: indistinguishable from a wedged process).  The router
+  declares it dead, fence-kills it, re-routes, and the supervisor
+  heals it the same way.
+
+The contract: **zero lost requests** (every future resolves, nothing
+double-delivered), **both replicas healed** (respawned at epoch 2 with
+a verified warm memory import, back in the routable set), and the
+supervisor's counters/state file record the whole story.
+
+Env knobs: SMOKE_LIFECYCLE_REQUESTS (default 6 per wave),
+SMOKE_LIFECYCLE_DEADLINE_S (default 300), SMOKE_LIFECYCLE_SLOW_S
+(default 0.5 — per-solve injected delay so the faults land mid-round).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# this smoke drills the replica lifecycle: repeats must reach replicas,
+# not the router's memoization plane
+os.environ["DERVET_TPU_REQUEST_CACHE"] = "0"
+
+N_REQ = int(os.environ.get("SMOKE_LIFECYCLE_REQUESTS", "6"))
+DEADLINE_S = float(os.environ.get("SMOKE_LIFECYCLE_DEADLINE_S", "300"))
+SLOW_S = os.environ.get("SMOKE_LIFECYCLE_SLOW_S", "0.5")
+
+
+def log(msg: str) -> None:
+    print(f"lifecycle-smoke: {msg}", file=sys.stderr, flush=True)
+
+
+def workload(tag: str):
+    """N single-case requests with distinct window lengths (distinct LP
+    structures, so routing spreads them) and distinct content."""
+    from dervet_tpu.benchlib import synthetic_sensitivity_cases
+    out = {}
+    for i in range(N_REQ):
+        case = synthetic_sensitivity_cases(1, n=72 + 24 * i, months=1)[0]
+        for der_tag, _, keys in case.ders:
+            if der_tag == "Battery":
+                keys["ene_max_rated"] = 8000.0 + 10.0 * i
+        out[f"{tag}{i:02d}"] = {0: case}
+    return out
+
+
+def route_wave(router, reqs):
+    return {rid: router.submit(cases, request_id=rid,
+                               deadline_s=DEADLINE_S)
+            for rid, cases in reqs.items()}
+
+
+def collect(futs, timeout=600):
+    return {rid: fut.result(timeout=timeout) for rid, fut in futs.items()}
+
+
+def _wait(pred, timeout, msg):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(msg)
+
+
+def pick_victim(router, name):
+    """Wait until NAME holds >= 1 admitted (unfinished) request and has
+    published a warm-start export — a kill now is mid-request and the
+    replacement has a blob to import."""
+    from dervet_tpu.service import ServiceJournal
+
+    def ready():
+        h = router.replicas.get(name)
+        if h is None or h.process is None:
+            return False
+        states = ServiceJournal.replay_path(
+            h.spool / "service_journal.jsonl")
+        inflight = sum(1 for e in states.values()
+                       if e["state"] == "admitted")
+        return inflight >= 1 and \
+            (h.spool / "memory_export.pkl").exists()
+
+    _wait(ready, 240, f"{name}: no admitted in-flight request + warm "
+                      "export before the wave drained — fault window "
+                      "missed")
+    return router.replicas[name]
+
+
+def wait_healed(router, sup, name, *, epoch, restarts):
+    """The replacement is routable again: fresh beats at the bumped
+    epoch, breaker closed by the probe cycle, supervisor record UP."""
+    def healed():
+        h = router.replicas.get(name)
+        if h is None or h.process is None or h.alive() is not True:
+            return False
+        m = router.metrics()["replicas"].get(name, {})
+        rec = sup.snapshot()["replicas"].get(name, {})
+        return (m.get("state") == "up"
+                and m.get("breaker", {}).get("state") == "closed"
+                and rec.get("state") == "up"
+                and int(h.epoch or 0) >= epoch)
+
+    _wait(healed, 240, f"{name}: never healed to a routable epoch-"
+                       f"{epoch} replacement")
+    snap = sup.snapshot()
+    rec = snap["replicas"][name]
+    assert rec["restarts"] >= restarts, rec
+    assert rec["warm_imports"] >= 1, \
+        f"{name}: replacement respawned cold (no memory import)"
+    assert rec["last_restart_reason"], rec
+    log(f"{name} healed: epoch {router.replicas[name].epoch}, "
+        f"restarts {rec['restarts']}, warm imports "
+        f"{rec['warm_imports']} ({rec['last_restart_reason']})")
+
+
+def main() -> int:
+    import tempfile
+
+    from dervet_tpu.service import FleetRouter, FleetSupervisor, ReplicaSpec
+
+    workdir = Path(tempfile.mkdtemp(prefix="lifecycle-smoke-"))
+    report = {"requests_per_wave": N_REQ}
+    env = {"DERVET_TPU_FAULT_SLOW": "all",
+           "DERVET_TPU_FAULT_SLOW_S": SLOW_S}
+    specs = [ReplicaSpec(workdir / f"r{i}", name=f"r{i}", backend="cpu",
+                         env=env)
+             for i in range(2)]
+    router = FleetRouter([], fleet_dir=workdir / "fleet",
+                         heartbeat_timeout_s=3.0, tick_s=0.05,
+                         breaker_opts={"min_samples": 1,
+                                       "failure_threshold": 0.5,
+                                       "cooldown_s": 1.0}).start()
+    sup = FleetSupervisor(router, specs, backoff_base_s=0.2,
+                          tick_s=0.1)
+    assert sup.enabled, "supervision disabled in the environment"
+    sup.start()
+    try:
+        _wait(lambda: all(sup.snapshot()["replicas"][s.name]["state"]
+                          == "up" for s in specs),
+              240, "supervised fleet never came up")
+        log("2-replica supervised fleet up")
+
+        # ---- kill drill: SIGKILL r0 mid-request ----------------------
+        futs = route_wave(router, workload("kill."))
+        victim = pick_victim(router, "r0")
+        pid = victim.process.pid
+        victim.process.send_signal(signal.SIGKILL)
+        log(f"SIGKILLed r0 (pid {pid}) mid-request")
+        results = collect(futs)
+        assert len(results) == N_REQ, "lost requests in the kill drill"
+        wait_healed(router, sup, "r0", epoch=2, restarts=1)
+
+        # ---- hang drill: SIGSTOP r1 mid-request ----------------------
+        futs2 = route_wave(router, workload("hang."))
+        victim = pick_victim(router, "r1")
+        pid = victim.process.pid
+        os.kill(pid, signal.SIGSTOP)
+        log(f"SIGSTOPed r1 (pid {pid}) mid-request — heartbeats frozen")
+        results2 = collect(futs2)
+        assert len(results2) == N_REQ, "lost requests in the hang drill"
+        wait_healed(router, sup, "r1", epoch=2, restarts=1)
+
+        # ---- the contract --------------------------------------------
+        m = router.metrics()
+        r = m["routing"]
+        assert r["completed"] == 2 * N_REQ, r
+        assert r["failed"] == 0, r
+        assert r["failovers"] >= 2, r
+        snap = sup.snapshot()
+        assert snap["counters"]["restarts"] >= 2, snap["counters"]
+        assert snap["counters"]["warm_imports"] >= 2, snap["counters"]
+        assert snap["counters"]["quarantined"] == 0, snap["counters"]
+        state_doc = json.loads(
+            (workdir / "fleet" / "supervisor_state.json").read_text())
+        assert state_doc["replicas"]["r0"]["restarts"] >= 1
+        assert state_doc["replicas"]["r1"]["restarts"] >= 1
+        report.update({
+            "restarts": snap["counters"]["restarts"],
+            "warm_imports": snap["counters"]["warm_imports"],
+            "completed": r["completed"],
+            "failovers": r["failovers"],
+            "rerouted": r["rerouted"],
+            "harvested": r["harvested"],
+            "duplicates_suppressed": r["duplicates_suppressed"],
+            "epochs": {n: router.replicas[n].epoch
+                       for n in ("r0", "r1")},
+        })
+    finally:
+        sup.stop()
+        router.close()
+
+    report["ok"] = True
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -578,6 +578,135 @@ class TestRouterFailover:
             r.close(terminate_replicas=False)
 
 
+class EpochStubReplica(StubReplica):
+    """Stub whose heartbeats carry a controllable incarnation epoch —
+    the handle's `epoch` is what it was spawned with, `hb_epoch` is
+    what its beats claim (split so tests can play a zombie process
+    beating with a fenced epoch, then a replacement beating above it)."""
+
+    def __init__(self, name, epoch=None):
+        super().__init__(name)
+        self.epoch = epoch
+        self.hb_epoch = epoch
+
+    def heartbeat(self):
+        hb = super().heartbeat()
+        if hb is not None and self.hb_epoch is not None:
+            hb["epoch"] = self.hb_epoch
+        return hb
+
+
+class TestEpochFence:
+    """The satellite drill: `_declare_dead` re-route racing a
+    concurrent same-named respawn.  The heartbeat-epoch fence must
+    reject the stale incarnation's late beats and answers while the
+    replacement (strictly higher epoch) earns routing back — exactly
+    one answer ever reaches the caller."""
+
+    def test_zombie_beats_at_fence_epoch_never_resurrect(self):
+        a = EpochStubReplica("a", epoch=1)
+        b = EpochStubReplica("b", epoch=1)
+        r = _router([a, b])
+        try:
+            fut = r.submit(_stub_cases(), request_id="x1")
+            victim = a if "x1" in a.reqs else b
+            other = b if victim is a else a
+            victim.beating = False
+            _wait(lambda: "x1" in other.reqs, msg="not rerouted")
+            assert victim.state == "dead"
+            # declare-dead armed the fence at the corpse's incarnation
+            assert victim.fence_epoch == 1
+            # the zombie wakes up and resumes beating with its OWN
+            # (fenced) epoch: the beats are discredited wholesale — no
+            # liveness credit, no resurrection, routing stays closed
+            victim.beating = True
+            time.sleep(0.2)
+            assert victim.state == "dead"
+            assert r._hb_cache[victim.name] is None
+            # its late answer is inert (the route was resolved at
+            # failover) — only the re-routed sibling delivers
+            victim.answers["x1"] = ("done", object())
+            real = object()
+            other.answers["x1"] = ("done", real)
+            res = fut.result(timeout=5)
+            assert res.result is real and res.replica == other.name
+            m = r.metrics()["routing"]
+            assert m["rerouted"] == 1
+            # a replacement incarnation beating ABOVE the fence is the
+            # only thing that resurrects the name — and it disarms it
+            victim.hb_epoch = 2
+            _wait(lambda: victim.state == "up",
+                  msg="replacement epoch never resurrected the name")
+            assert victim.fence_epoch is None
+        finally:
+            r.close(terminate_replicas=False)
+
+    def test_respawn_race_no_double_delivery(self):
+        """Replacement handle adopted DURING the failover window, same
+        name, epoch bumped past the fence: the stale process's answer
+        can never be delivered and the caller sees exactly one result."""
+        a = EpochStubReplica("a", epoch=1)
+        b = EpochStubReplica("b", epoch=1)
+        r = _router([a, b])
+        try:
+            fut = r.submit(_stub_cases(), request_id="x1")
+            victim = a if "x1" in a.reqs else b
+            other = b if victim is a else a
+            victim.beating = False
+            _wait(lambda: "x1" in other.reqs, msg="not rerouted")
+            # supervisor respawn lands mid-flight: same name, epoch+1
+            repl = EpochStubReplica(victim.name, epoch=2)
+            r.adopt_replica(repl)
+            assert r.replicas[victim.name] is repl
+            # replacement re-proves liveness from scratch (fresh grace)
+            assert r._first_seen[victim.name] is None
+            # the zombie answers late through its orphaned handle: it
+            # is no longer registered or polled — no double delivery
+            victim.beating = True
+            victim.answers["x1"] = ("done", object())
+            real = object()
+            other.answers["x1"] = ("done", real)
+            res = fut.result(timeout=5)
+            assert res.result is real and res.replica == other.name
+            m = r.metrics()["routing"]
+            assert m["rerouted"] == 1
+            assert m["duplicates_suppressed"] == 0
+            # the replacement's own fresh beats earn it back into the
+            # routable set
+            _wait(lambda: r._first_seen[repl.name] is not None,
+                  msg="replacement's beats never credited")
+            assert r.replicas[repl.name].state == "up"
+        finally:
+            r.close(terminate_replicas=False)
+
+    def test_spool_epoch_filter_discredits_stale_beats(self, tmp_path):
+        """SpoolReplica path: a heartbeat.json written by an older
+        incarnation over the shared spool (epoch below the handle's) is
+        discredited entirely; the matching epoch restores credit."""
+        from dervet_tpu.service.fleet import HEARTBEAT_FILE, SpoolReplica
+        spool = tmp_path / "r0"
+        h = SpoolReplica("r0", spool)
+        h.epoch = 2
+        r = _router([h])
+        try:
+            def beat(epoch):
+                tmp = spool / f".{HEARTBEAT_FILE}.tmp"
+                tmp.write_text(json.dumps(
+                    {"t": time.time(), "name": "r0", "epoch": epoch}))
+                tmp.replace(spool / HEARTBEAT_FILE)
+
+            beat(1)                 # the fenced predecessor's late write
+            time.sleep(0.2)
+            assert r._hb_cache["r0"] is None
+            assert r._first_seen["r0"] is None
+            beat(2)                 # the real incarnation announces
+            _wait(lambda: r._first_seen["r0"] is not None,
+                  msg="current-epoch beat never credited")
+            assert r._hb_cache["r0"]["epoch"] == 2
+        finally:
+            r.close(terminate_replicas=False)
+
+
 class TestRouterHedging:
     def test_deadline_pressure_hedges_first_answer_wins(self):
         a, b = StubReplica("a"), StubReplica("b")

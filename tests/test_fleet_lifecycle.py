@@ -1,0 +1,343 @@
+"""Fleet lifecycle supervisor tests (service/lifecycle.py): crash
+respawn with epoch bump + warm memory import, exponential crash-loop
+backoff, the typed quarantine terminal state, telemetry-driven
+autoscaling up/down with clean drain, the kill switch, and the
+supervisor state file the status CLI reads.
+
+All supervision logic runs against fake replicas through the
+injectable ``spawn_fn`` — no subprocesses; the real-process path is
+drilled by scripts/lifecycle_smoke.py and the chaos soak's supervised
+phase."""
+import json
+import time
+
+import pytest
+
+from dervet_tpu.service import FleetRouter
+from dervet_tpu.service.fleet import (MEMORY_EXPORT_FILE, ReplicaHandle,
+                                      SpoolReplica)
+from dervet_tpu.service.lifecycle import (BACKOFF, QUARANTINED, STOPPED,
+                                          UP, FleetSupervisor,
+                                          ReplicaSpec, supervision_enabled)
+from dervet_tpu.utils.errors import ReplicaQuarantinedError
+
+
+class FakeReplica(ReplicaHandle):
+    """Controllable replica: beats/liveness/load under test control."""
+
+    def __init__(self, name, epoch=None):
+        super().__init__(name)
+        self.epoch = epoch
+        self.beating = True
+        self.alive_flag = True
+        self.queue_depth = 0.0
+        self.imported = []
+        self.terminated = False
+
+    def submit(self, cases, rid, **kw):
+        pass
+
+    def poll(self, rid):
+        return None
+
+    def heartbeat(self):
+        if not self.beating:
+            return None
+        return {"t": time.time(), "name": self.name,
+                **({"epoch": self.epoch} if self.epoch is not None
+                   else {})}
+
+    def alive(self):
+        return self.alive_flag
+
+    def published_load(self):
+        return {"queue_depth": float(self.queue_depth),
+                "drain_rate_rps": 1.0, "pending": 0.0}
+
+    def import_memory(self, blob):
+        self.imported.append(blob)
+
+    def terminate(self, timeout=30.0):
+        self.terminated = True
+        self.alive_flag = False
+        self.beating = False
+
+    def die(self):
+        self.beating = False
+        self.alive_flag = False
+
+
+class SpawnLog:
+    """Injectable spawn_fn: records every call, returns FakeReplicas."""
+
+    def __init__(self):
+        self.calls = []
+        self.spawned = []
+
+    def __call__(self, spool, *, name=None, epoch=None, **kw):
+        self.calls.append({"spool": spool, "name": name, "epoch": epoch,
+                           **kw})
+        fake = FakeReplica(name, epoch=epoch)
+        self.spawned.append(fake)
+        return fake
+
+
+def _wait(pred, timeout=10.0, msg="condition not reached"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(msg)
+
+
+def _router(tmp_path, **kw):
+    kw.setdefault("heartbeat_timeout_s", 0.4)
+    kw.setdefault("tick_s", 0.02)
+    kw.setdefault("startup_grace_s", 5.0)
+    kw.setdefault("fleet_dir", tmp_path / "fleet")
+    return FleetRouter([], **kw).start()
+
+
+def _supervisor(router, specs, spawn, **kw):
+    kw.setdefault("backoff_base_s", 0.05)
+    kw.setdefault("backoff_max_s", 0.5)
+    kw.setdefault("tick_s", 0.03)
+    return FleetSupervisor(router, specs, spawn_fn=spawn, **kw)
+
+
+class TestKillSwitch:
+    def test_disabled_supervisor_is_a_complete_noop(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv("DERVET_TPU_FLEET_SUPERVISE", "0")
+        assert not supervision_enabled()
+        r = _router(tmp_path)
+        spawn = SpawnLog()
+        sup = _supervisor(r, [ReplicaSpec(tmp_path / "r0")], spawn)
+        try:
+            sup.start()
+            # nothing attached, nothing spawned, no thread, no state
+            assert r.supervisor is None
+            assert spawn.calls == []
+            assert sup._thread is None
+            sup.on_replica_dead("r0", "crash")       # also a no-op
+            time.sleep(0.1)
+            assert spawn.calls == []
+            assert not (tmp_path / "fleet" /
+                        "supervisor_state.json").exists()
+        finally:
+            sup.stop()
+            r.close(terminate_replicas=False)
+
+
+class TestRespawn:
+    def test_crash_respawns_with_epoch_bump_and_warm_import(
+            self, tmp_path):
+        spool = tmp_path / "r0"
+        spool.mkdir()
+        # the dead incarnation's last published warm-start export
+        (spool / MEMORY_EXPORT_FILE).write_bytes(b"WARM-BLOB")
+        r = _router(tmp_path)
+        spawn = SpawnLog()
+        sup = _supervisor(r, [ReplicaSpec(spool)], spawn,
+                          rapid_crash_window_s=0.0)   # never quarantine
+        try:
+            sup.start()
+            assert r.supervisor is sup
+            _wait(lambda: "r0" in r.replicas, msg="initial spawn")
+            assert spawn.calls[0]["epoch"] == 1
+            first = spawn.spawned[0]
+            # cold start: no warm import on the initial spawn
+            assert first.imported == []
+            _wait(lambda: sup.snapshot()["replicas"]["r0"]["state"]
+                  == UP, msg="never reached UP")
+
+            first.die()
+            _wait(lambda: len(spawn.spawned) >= 2, msg="no respawn")
+            second = spawn.spawned[1]
+            assert spawn.calls[1]["epoch"] == 2       # fence bump
+            _wait(lambda: r.replicas.get("r0") is second,
+                  msg="router never adopted the replacement")
+            # warm respawn: the dead spool's export rode along
+            _wait(lambda: second.imported == [b"WARM-BLOB"],
+                  msg="no warm import")
+            assert second.restarts == 1
+            assert second.last_restart_reason == "process exited"
+            snap = sup.snapshot()
+            assert snap["counters"]["restarts"] == 1
+            assert snap["counters"]["warm_imports"] == 1
+            assert snap["replicas"]["r0"]["epoch"] == 2
+            assert snap["replicas"]["r0"]["last_restart_reason"] \
+                == "process exited"
+        finally:
+            sup.stop()
+            r.close(terminate_replicas=False)
+
+    def test_backoff_grows_exponentially(self, tmp_path):
+        r = _router(tmp_path)
+        spawn = SpawnLog()
+        sup = _supervisor(r, [ReplicaSpec(tmp_path / "r0")], spawn,
+                          backoff_base_s=0.1, backoff_max_s=10.0,
+                          rapid_crash_window_s=100.0,
+                          quarantine_after=10)
+        try:
+            sup.start()
+            _wait(lambda: len(spawn.spawned) == 1, msg="initial spawn")
+            _wait(lambda: sup.snapshot()["replicas"]["r0"]["state"]
+                  == UP, msg="never up")
+            rec = sup._records["r0"]
+            t0 = time.monotonic()
+            sup.on_replica_dead("r0", "crash #1")
+            assert rec.state == BACKOFF
+            d1 = rec.backoff_until - t0
+            # simulate the respawned incarnation crashing again, fast
+            rec.state = UP
+            rec.last_spawn_mono = time.monotonic()
+            t1 = time.monotonic()
+            sup.on_replica_dead("r0", "crash #2")
+            d2 = rec.backoff_until - t1
+            assert d2 > d1 * 1.5        # base * 2^k doubling
+        finally:
+            sup.stop()
+            r.close(terminate_replicas=False)
+
+
+class TestQuarantine:
+    def test_rapid_crashes_reach_typed_quarantine(self, tmp_path):
+        r = _router(tmp_path)
+        spawn = SpawnLog()
+        sup = _supervisor(r, [ReplicaSpec(tmp_path / "r0")], spawn,
+                          rapid_crash_window_s=60.0, quarantine_after=3)
+        try:
+            sup.start()
+            _wait(lambda: len(spawn.spawned) == 1, msg="initial spawn")
+            _wait(lambda: sup.snapshot()["replicas"]["r0"]["state"]
+                  == UP, msg="never up")
+            # crash-loop: each incarnation dies as soon as it is live
+            for i in range(3):
+                if sup.snapshot()["replicas"]["r0"]["state"] \
+                        == QUARANTINED:
+                    break
+                _wait(lambda: spawn.spawned[-1].alive_flag,
+                      msg="no live incarnation")
+                n = len(spawn.spawned)
+                spawn.spawned[-1].die()
+                _wait(lambda: (len(spawn.spawned) > n
+                               or sup.snapshot()["replicas"]["r0"]
+                               ["state"] == QUARANTINED),
+                      msg="no respawn/quarantine after death")
+            _wait(lambda: sup.snapshot()["replicas"]["r0"]["state"]
+                  == QUARANTINED, msg="never quarantined")
+            snap = sup.snapshot()["replicas"]["r0"]
+            q = snap["quarantine"]
+            assert q["kind"] == "replica_quarantined"
+            assert q["replica"] == "r0"
+            assert q["crashes"] >= 3
+            assert q["retry_hint"] is None
+            n_spawns = len(spawn.spawned)
+            time.sleep(0.3)
+            # terminal: no hot-loop respawning out of quarantine
+            assert len(spawn.spawned) == n_spawns
+            # the typed error round-trips like the rest of the family
+            err = ReplicaQuarantinedError("x", replica="r0", crashes=3,
+                                          last_reason="boom")
+            assert err.as_dict()["kind"] == "replica_quarantined"
+
+            # operator release clears it and respawns immediately
+            assert sup.release("r0")
+            _wait(lambda: len(spawn.spawned) > n_spawns,
+                  msg="release did not respawn")
+            assert sup.snapshot()["counters"]["released"] == 1
+        finally:
+            sup.stop()
+            r.close(terminate_replicas=False)
+
+
+class TestAutoscale:
+    def test_scale_up_on_pressure_then_down_after_clean_drain(
+            self, tmp_path):
+        r = _router(tmp_path)
+        spawn = SpawnLog()
+        sup = _supervisor(r, [ReplicaSpec(tmp_path / "r0")], spawn,
+                          min_replicas=1, max_replicas=2,
+                          scale_up_backlog=4.0, scale_pressure_s=0.1,
+                          scale_down_idle_s=0.15,
+                          spool_root=tmp_path / "scaled")
+        try:
+            sup.start()
+            _wait(lambda: len(spawn.spawned) == 1, msg="initial spawn")
+            base = spawn.spawned[0]
+            _wait(lambda: sup.snapshot()["replicas"]["r0"]["state"]
+                  == UP, msg="never up")
+            base.queue_depth = 50.0         # sustained backlog
+            _wait(lambda: len(spawn.spawned) >= 2,
+                  msg="no scale-up under sustained pressure")
+            scaled = spawn.spawned[1]
+            assert scaled.name.startswith("scale")
+            assert spawn.calls[1]["spool"] == tmp_path / "scaled" \
+                / scaled.name
+            _wait(lambda: scaled.name in r.replicas,
+                  msg="scaled replica not adopted")
+            snap = sup.snapshot()
+            assert snap["counters"]["scale_up"] == 1
+            assert snap["replicas"][scaled.name]["scaled"] is True
+            # bounded: pressure continues but max_replicas=2 holds
+            time.sleep(0.3)
+            assert len(spawn.spawned) == 2
+
+            # idle fleet: the scaled replica drains CLEAN and goes away
+            base.queue_depth = 0.0
+            scaled.queue_depth = 0.0
+            _wait(lambda: sup.snapshot()["replicas"][scaled.name]
+                  ["state"] == STOPPED, msg="no scale-down")
+            assert scaled.terminated        # polite drain, not a kill
+            assert scaled.name not in r.replicas
+            assert sup.snapshot()["counters"]["scale_down"] == 1
+            # the baseline replica is never scaled down
+            assert "r0" in r.replicas
+        finally:
+            sup.stop()
+            r.close(terminate_replicas=False)
+
+
+class TestStateAndAdoption:
+    def test_state_file_published_for_status_cli(self, tmp_path):
+        r = _router(tmp_path)
+        spawn = SpawnLog()
+        sup = _supervisor(r, [ReplicaSpec(tmp_path / "r0")], spawn)
+        try:
+            sup.start()
+            state_path = tmp_path / "fleet" / "supervisor_state.json"
+            _wait(lambda: state_path.exists(), msg="no state file")
+            doc = json.loads(state_path.read_text())
+            assert doc["enabled"] is True
+            assert "r0" in doc["replicas"]
+            assert doc["replicas"]["r0"]["restarts"] == 0
+            # and the router's metrics() carries the same snapshot
+            assert r.metrics()["supervisor"]["replicas"]["r0"]
+        finally:
+            sup.stop()
+            r.close(terminate_replicas=False)
+
+    def test_existing_spool_replicas_adopted_without_specs(
+            self, tmp_path):
+        spool = tmp_path / "r0"
+        handle = SpoolReplica("r0", spool)    # caller-spawned, no proc
+        handle.epoch = 4
+        r = FleetRouter([handle], fleet_dir=tmp_path / "fleet",
+                        heartbeat_timeout_s=0.4, tick_s=0.02).start()
+        spawn = SpawnLog()
+        sup = _supervisor(r, [], spawn)
+        try:
+            sup.start()
+            snap = sup.snapshot()["replicas"]
+            assert "r0" in snap
+            assert snap["r0"]["epoch"] == 4
+            # a crash of the adopted replica respawns at epoch 5
+            sup.on_replica_dead("r0", "heartbeats stopped")
+            _wait(lambda: spawn.calls, msg="no respawn of adopted")
+            assert spawn.calls[0]["epoch"] == 5
+            assert spawn.calls[0]["spool"] == spool
+        finally:
+            sup.stop()
+            r.close(terminate_replicas=False)
