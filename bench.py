@@ -286,6 +286,11 @@ def main() -> None:
             legs["serving_fleet"] = serving_fleet_leg()
         except Exception as e:          # noqa: BLE001
             legs["serving_fleet"] = {"error": str(e)[:300]}
+    if int(os.environ.get("BENCH_MC", "1")):
+        try:
+            legs["monte_carlo"] = monte_carlo_leg()
+        except Exception as e:          # noqa: BLE001
+            legs["monte_carlo"] = {"error": str(e)[:300]}
     if int(os.environ.get("BENCH_PORTFOLIO", "1")):
         try:
             legs["portfolio"] = portfolio_leg()
@@ -1653,6 +1658,93 @@ def design_leg() -> dict:
         "design_metrics": {k: m["design"][k] for k in
                            ("requests", "candidates", "finalists",
                             "screen_rounds", "screen_s")},
+    }
+
+
+def monte_carlo_leg() -> dict:
+    """Uncertainty-product proof (``legs.monte_carlo``,
+    dervet_tpu/stochastic): one N-sample Monte-Carlo valuation request
+    through the service — the whole sample mass screens in ONE
+    cert-off dispatch round, the quantile/CVaR-pinning samples re-solve
+    fresh at full certified tolerances, and the distribution publishes
+    with float64 host-side stats.
+
+    Publishes the two tier throughputs the product's economics rest on
+    (SCREENING samples/s vs CERTIFIED samples/s) plus the batching win
+    (samples / device dispatches) and the amortization curve (cold vs
+    warm compile events).
+
+    Gates: every pinning sample certified, the screening mass never
+    cert-stamped, batching win >= 10x, warm repeat compiling ZERO
+    programs AND serializing a byte-identical mc_distribution.json
+    (the fixed-seed determinism contract)."""
+    from dervet_tpu.benchlib import synthetic_case
+    from dervet_tpu.service import ScenarioService
+    from dervet_tpu.stochastic import MCSpec
+
+    samples = int(os.environ.get("BENCH_MC_SAMPLES", "512"))
+    hours = int(os.environ.get("BENCH_MC_HOURS", "72"))
+    spec = MCSpec(n_samples=samples, seed=11)
+
+    def case():
+        c = synthetic_case()
+        c.scenario["allow_partial_year"] = True
+        c.datasets.time_series = c.datasets.time_series.iloc[:hours]
+        return c
+
+    svc = ScenarioService(backend="jax", max_wait_s=0.05)
+    svc.start()
+    try:
+        t0 = time.time()
+        res = svc.submit_montecarlo(case(), spec,
+                                    request_id="bench-mc").result()
+        t_cold = time.time() - t0
+        t0 = time.time()
+        warm = svc.submit_montecarlo(case(), spec,
+                                     request_id="bench-mc").result()
+        t_warm = time.time() - t0
+        m = svc.metrics()
+    finally:
+        svc.close()
+
+    dispatches = int(res.engine["dispatches"])
+    batching_win = samples / max(1, dispatches)
+    byte_identical = warm.to_json() == res.to_json()
+    ok = (res.pinning_all_certified
+          and not res.engine["certification_stamped_screening"]
+          and batching_win >= 10
+          and warm.engine["compile_events"] == 0
+          and byte_identical)
+    log(f"bench[monte_carlo]: {samples} samples -> "
+        f"{res.tier_mix['certified']} certified-pinning "
+        f"({res.tier_mix['quarantined']} quarantined); cold "
+        f"{t_cold:.1f}s, warm {t_warm:.1f}s; screening "
+        f"{res.engine['samples_per_s_screening']} samples/s vs "
+        f"certified {res.engine['samples_per_s_certified']}; batching "
+        f"win {batching_win:.0f}x ({dispatches} dispatches), compiles "
+        f"{res.engine['compile_events']} cold -> "
+        f"{warm.engine['compile_events']} warm; byte-identical "
+        f"{byte_identical}; gates: {'OK' if ok else 'FAIL'}")
+    if not ok:
+        raise SystemExit(7)
+    return {
+        "samples": samples, "hours": hours,
+        "cold_request_s": round(t_cold, 2),
+        "warm_request_s": round(t_warm, 2),
+        "samples_per_s_screening":
+            res.engine["samples_per_s_screening"],
+        "samples_per_s_certified":
+            res.engine["samples_per_s_certified"],
+        "dispatches": dispatches,
+        "batching_win_x": round(batching_win, 1),
+        "cold_compile_events": int(res.engine["compile_events"]),
+        "warm_compile_events": int(warm.engine["compile_events"]),
+        "byte_identical_repeat": bool(byte_identical),
+        "tier_mix": dict(res.tier_mix),
+        "cvar_alpha": res.stats["cvar_alpha"],
+        "mc_metrics": {k: m["monte_carlo"][k] for k in
+                       ("requests", "samples", "certified_samples",
+                        "quarantined")},
     }
 
 

@@ -4,7 +4,9 @@ the persistent scenario service instead (service.server.serve_main);
 ``dervet-tpu design CASE --bounds ...`` runs a one-shot BOOST sizing
 frontier (design.cli.design_main); ``dervet-tpu portfolio REQ.json``
 runs a one-shot coupled-portfolio co-optimization
-(portfolio.cli.portfolio_main); ``dervet-tpu status SPOOL_DIR`` renders
+(portfolio.cli.portfolio_main); ``dervet-tpu montecarlo CASE
+--samples N`` runs a one-shot Monte-Carlo uncertainty valuation
+(stochastic.cli.montecarlo_main); ``dervet-tpu status SPOOL_DIR`` renders
 live fleet health from the published telemetry and ``dervet-tpu trace
 RID DIR`` stitches + pretty-prints one request's span tree
 (telemetry.ops)."""
@@ -33,6 +35,12 @@ def main(argv=None):
         # 75 preempted, 2 infeasible)
         from .portfolio.cli import portfolio_main
         raise SystemExit(portfolio_main(argv[1:]))
+    if argv and argv[0] == "montecarlo":
+        # one-shot Monte-Carlo valuation: seeded sample mass at the
+        # screening tier, quantile-pinning samples certified, CVaR +
+        # quantile distribution artifacts (exit 0 ok, 75 preempted)
+        from .stochastic.cli import montecarlo_main
+        raise SystemExit(montecarlo_main(argv[1:]))
     if argv and argv[0] == "fleet":
         # supervised multi-replica fleet: spawn N serve replicas behind
         # a FleetRouter with the lifecycle supervisor attached (crash

@@ -68,6 +68,15 @@ def build_parser() -> argparse.ArgumentParser:
                              "kW x hours inside it")
     parser.add_argument("--refine-rounds", type=int, default=1,
                         help="ordinal refinement re-screens (default 1)")
+    parser.add_argument("--risk-samples", type=int, default=None,
+                        help="enable risk-aware mode: Monte-Carlo "
+                             "samples per finalist — the frontier gains "
+                             "mc_mean/mc_cvar columns and a (capex, "
+                             "E[value], CVaR) Pareto axis")
+    parser.add_argument("--risk-seed", type=int, default=0,
+                        help="risk-mode sampler seed (default 0)")
+    parser.add_argument("--risk-alpha", type=float, default=0.95,
+                        help="risk-mode CVaR level (default 0.95)")
     parser.add_argument("--backend", default="jax",
                         choices=["jax", "cpu"],
                         help="screening/certification dispatch backend "
@@ -96,7 +105,10 @@ def design_main(argv=None) -> int:
                                                    kwh=dims.get("kwh"))},
         population=args.population, top_k=args.top_k, budget=args.budget,
         duration_hours=_pair(args.duration_hours, "--duration-hours"),
-        refine_rounds=args.refine_rounds).validate()
+        refine_rounds=args.refine_rounds,
+        risk=(None if args.risk_samples is None
+              else {"samples": args.risk_samples, "seed": args.risk_seed,
+                    "alpha": args.risk_alpha})).validate()
     cases = Params.initialize(args.parameters_filename,
                               base_path=args.base_path,
                               verbose=args.verbose)

@@ -69,18 +69,27 @@ def spearman_rank(a, b) -> Optional[float]:
     return round(float((ra * rb).sum() / denom), 4)
 
 
-def dominated_mask(capex, operating_value) -> np.ndarray:
+def dominated_mask(capex, operating_value, cvar=None) -> np.ndarray:
     """Pareto dominance over (capex, operating value) — both
     lower-is-better (operating value is a cost; negative = net benefit).
     Entry i is dominated when some j is at least as good on both axes
-    and strictly better on one."""
+    and strictly better on one.  ``cvar`` (risk-aware design mode) adds
+    a third lower-is-better axis — CVaR of the operating-value
+    distribution — so a design that buys tail-risk protection with a
+    slightly worse expectation stays on the frontier."""
     c = np.asarray(capex, dtype=float)
     v = np.asarray(operating_value, dtype=float)
+    axes = [c, v]
+    if cvar is not None:
+        axes.append(np.asarray(cvar, dtype=float))
     n = c.size
     out = np.zeros(n, dtype=bool)
     for i in range(n):
-        better_eq = (c <= c[i]) & (v <= v[i])
-        strictly = (c < c[i]) | (v < v[i])
+        better_eq = np.ones(n, dtype=bool)
+        strictly = np.zeros(n, dtype=bool)
+        for a in axes:
+            better_eq &= a <= a[i]
+            strictly |= a < a[i]
         out[i] = bool(np.any(better_eq & strictly & (np.arange(n) != i)))
     return out
 
@@ -194,13 +203,17 @@ class DesignFrontier:
 def build_frontier(spec: DesignSpec, case, report: ScreenReport,
                    final_scens: Optional[Dict[int, MicrogridScenario]],
                    *, fidelity: str = FIDELITY_CERTIFIED,
-                   request_id: Optional[str] = None) -> DesignFrontier:
+                   request_id: Optional[str] = None,
+                   risk_eval: Optional[Dict] = None) -> DesignFrontier:
     """Assemble the :class:`DesignFrontier` from the screening report and
     (for the certified tier) the finalists' exactly-solved scenarios
     keyed by candidate index.  ``final_scens=None`` builds a
     screening-only DEGRADED frontier (the load-shed answer): ranked by
     the ordinal screen, certified=False everywhere, explicit resubmit
-    hint."""
+    hint.  ``risk_eval`` (risk-aware mode: per-candidate-index dicts
+    from :func:`~dervet_tpu.stochastic.engine.evaluate_finalist_risk`)
+    merges ``mc_mean``/``mc_cvar`` columns in and adds CVaR as a third
+    Pareto-dominance axis."""
     finalists = report.top(spec.top_k)
     population = report.table()
     targets = {(t, di or "1") for e in finalists
@@ -244,6 +257,11 @@ def build_frontier(spec: DesignSpec, case, report: ScreenReport,
                         "capex": e.capex, "total": e.total,
                         "lifetime_npv": e.lifetime_npv,
                         "certified": False, "reason": e.reason})
+        if risk_eval is not None:
+            row.update(risk_eval.get(e.candidate.index) or {
+                "mc_mean": float("nan"), "mc_cvar": float("nan"),
+                "mc_samples": 0, "mc_alpha": float("nan"),
+                "mc_quarantined": 0})
         rows.append(row)
     frontier = pd.DataFrame(rows)
     if len(frontier):
@@ -253,7 +271,9 @@ def build_frontier(spec: DesignSpec, case, report: ScreenReport,
         frontier["final_rank"] = np.arange(1, len(frontier) + 1)
         frontier["dominated"] = dominated_mask(
             frontier["capex"].to_numpy(),
-            frontier["operating_value"].to_numpy())
+            frontier["operating_value"].to_numpy(),
+            cvar=(frontier["mc_cvar"].to_numpy()
+                  if risk_eval is not None else None))
     corr = None
     if len(frontier) and final_scens is not None:
         solved = frontier[np.isfinite(frontier["total"])]
@@ -367,8 +387,19 @@ def run_design(case, spec: DesignSpec, *, backend: str = "jax",
             case, finalists, backend=backend, solver_opts=solver_opts,
             solver_cache=final_cache, supervisor=supervisor,
             request_id=request_id)
+        risk_eval = None
+        if spec.risk is not None:
+            # risk-aware mode: one screening-tier dispatch over the
+            # finalist x sample cross product (lazy import — stochastic
+            # imports the design package)
+            from ..stochastic.engine import evaluate_finalist_risk
+            risk_eval = evaluate_finalist_risk(
+                case, finalists, spec.risk_spec(), backend=backend,
+                solver_opts=solver_opts, caches=caches,
+                supervisor=supervisor, request_id=request_id)
         frontier = build_frontier(spec, case, report, final_scens,
-                                  request_id=request_id)
+                                  request_id=request_id,
+                                  risk_eval=risk_eval)
         from ..io.summary import run_health_report
         by_key = {candidate_key(e.candidate):
                   final_scens[e.candidate.index] for e in finalists}

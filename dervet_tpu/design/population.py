@@ -83,8 +83,34 @@ class DesignSpec:
     # candidate cost.  None inherits the base solver options (the
     # certified finalist tier always uses those unchanged).
     screen_variant: Optional[str] = None
+    # risk-aware mode: a Monte-Carlo sampler spec (the dict form
+    # stochastic.sampler.mc_spec_from_dict accepts — samples/seed/alpha/
+    # sigmas) evaluated per FINALIST after certification, adding
+    # E[operating value] and CVaR columns + a (capex, E[value], CVaR)
+    # Pareto axis to the frontier.  None = deterministic frontier.
+    risk: Optional[Dict] = None
+
+    def risk_spec(self):
+        """The risk mode's :class:`~dervet_tpu.stochastic.sampler.MCSpec`
+        (validated), or None.  Imported lazily — stochastic imports the
+        design package, so a module-scope import here would cycle.
+        Unless the request names a sample count, the per-finalist cloud
+        defaults to 256 draws (top_k x n_samples scenarios ride ONE
+        screening dispatch, so this stays a single batch)."""
+        if self.risk is None:
+            return None
+        if not isinstance(self.risk, dict):
+            raise ParameterError(
+                "design spec: risk must be an object of Monte-Carlo "
+                "sampler fields (samples/seed/alpha/...)")
+        from ..stochastic.sampler import mc_spec_from_dict
+        d = dict(self.risk)
+        if "samples" not in d and "n_samples" not in d:
+            d["samples"] = 256
+        return mc_spec_from_dict(d)
 
     def validate(self) -> "DesignSpec":
+        self.risk_spec()        # raises on a malformed risk block
         if not self.bounds and not self.grid:
             raise ParameterError("design spec: no size bounds and no "
                                  "explicit grid — nothing to design")
@@ -159,6 +185,8 @@ class DesignSpec:
                      if self.grid is not None else None),
             "refine_rounds": int(self.refine_rounds),
             "refine_keep": float(self.refine_keep),
+            "risk": (self.risk_spec().normalized()
+                     if self.risk is not None else None),
         }
 
 

@@ -60,14 +60,18 @@ class DesignState:
     """Per-request design bookkeeping carried from the screening phase
     to frontier assembly in the certified round's delivery."""
 
-    __slots__ = ("spec", "case", "report", "finalists")
+    __slots__ = ("spec", "case", "report", "finalists", "risk")
 
     def __init__(self, spec: DesignSpec, case, report: ScreenReport,
-                 finalists: List):
+                 finalists: List, risk: Optional[Dict] = None):
         self.spec = spec
         self.case = case
         self.report = report
         self.finalists = finalists
+        # risk-aware mode: per-candidate-index MC risk numbers computed
+        # during the screening phase (evaluate_finalist_risk), merged
+        # into the frontier at delivery
+        self.risk = risk
 
 
 def finalize_service_request(req, scenarios, ledger,
@@ -85,7 +89,8 @@ def finalize_service_request(req, scenarios, ledger,
         if s is not None:
             final_scens[e.candidate.index] = s
     frontier = build_frontier(state.spec, state.case, state.report,
-                              final_scens, request_id=req.request_id)
+                              final_scens, request_id=req.request_id,
+                              risk_eval=state.risk)
     health = run_health_report(
         {k: getattr(s, "health", {}) for k, s in scenarios.items()},
         {k: s.quarantine for k, s in scenarios.items()
@@ -254,7 +259,32 @@ class DesignRound:
                 self.answered.append(req)
                 continue
             self.stats["finalists"] += len(finalists)
-            req.design_state = DesignState(spec, case, report, finalists)
+            risk = None
+            if spec.risk is not None:
+                # risk-aware mode: the finalist x sample MC cloud is a
+                # screening-tier batch, so it runs HERE against the
+                # service's persistent screening caches; delivery merges
+                # the numbers into the certified frontier
+                from ..stochastic.engine import evaluate_finalist_risk
+                try:
+                    risk = evaluate_finalist_risk(
+                        case, finalists, spec.risk_spec(),
+                        backend=self.backend,
+                        solver_opts=self.solver_opts, caches=self.caches,
+                        supervisor=self.supervisor,
+                        request_id=req.request_id)
+                except PreemptedError as e:
+                    self._restore_request_span(req)
+                    self._preempt_all(self.requests[i:], e)
+                    raise
+                except Exception as e:
+                    self._restore_request_span(req)
+                    TellUser.error(f"design request {req.request_id}: "
+                                   f"risk evaluation failed: {e}")
+                    self._answer(req, e)
+                    continue
+            req.design_state = DesignState(spec, case, report, finalists,
+                                           risk=risk)
             req.cases = {candidate_key(e.candidate):
                          candidate_case(case, e.candidate)
                          for e in finalists}
@@ -287,7 +317,9 @@ def parse_design_request(payload: Dict, base_path=None):
             "budget": 1.5e6,                # optional capex cap
             "duration_hours": [1, 8],       # optional ESS coupling
             "grid": [[500, 1000], ...],     # optional explicit points
-            "refine_rounds": 1, "refine_keep": 0.25
+            "refine_rounds": 1, "refine_keep": 0.25,
+            "risk": {"samples": 256, "seed": 0, "alpha": 0.95}
+                                            # optional risk-aware mode
         }}
 
     Multi-DER specs use ``"bounds": {"Battery:1": {"kw": [..],
@@ -333,7 +365,8 @@ def parse_design_request(payload: Dict, base_path=None):
         duration_hours=_pair(d.get("duration_hours"), "duration_hours"),
         grid=grid,
         refine_rounds=int(d.get("refine_rounds", 1)),
-        refine_keep=float(d.get("refine_keep", 0.25)))
+        refine_keep=float(d.get("refine_keep", 0.25)),
+        risk=d.get("risk"))
     spec.validate()     # spec errors surface before any file IO
     from pathlib import Path
     p = Path(params)

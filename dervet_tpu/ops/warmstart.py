@@ -359,6 +359,19 @@ class SolutionMemory:
         with self._lock:
             self.stats[stat] = self.stats.get(stat, 0) + n
 
+    def ensure_capacity(self, n: int) -> None:
+        """Raise the LRU cap to at least ``n`` entries (never lowers).
+
+        Batched repeat workloads (Monte-Carlo valuation) need every
+        window of one batch resident: if the cap evicts mid-batch, a
+        repeat of the same request warm-starts the evicted windows
+        near-grade instead of exact-grade SUBSTITUTING, and the
+        re-converged iterates land on slightly different objectives
+        within the loose screening tolerance — silently breaking the
+        fixed-seed byte-identical replay contract."""
+        with self._lock:
+            self.max_entries = max(self.max_entries, int(n))
+
     # -- public API -----------------------------------------------------
     def lookup(self, skey, lp, tag: tuple
                ) -> Tuple[Optional[SeedEntry], Optional[str]]:

@@ -137,6 +137,28 @@ class ScenarioClient:
         return self.submit_design(case, spec, **kwargs).result(
             timeout=timeout)
 
+    def submit_montecarlo(self, case, spec=None, *, request_id=None,
+                          priority: int = 0,
+                          deadline_s: Optional[float] = None,
+                          **spec_kwargs) -> Future:
+        """Admit a MONTE-CARLO request (batched uncertainty valuation)
+        with the same bounded, jittered retry-after backoff as
+        :meth:`submit`."""
+        return self._submit_with_retry(
+            "montecarlo ", lambda: self.service.submit_montecarlo(
+                case, spec, request_id=request_id, priority=priority,
+                deadline_s=deadline_s, **spec_kwargs))
+
+    def montecarlo(self, case, spec=None, *,
+                   timeout: Optional[float] = None, **kwargs):
+        """Submit a monte-carlo request and block for its
+        :class:`~dervet_tpu.stochastic.distribution.MCDistribution`.
+        Check ``result.fidelity`` — a ``"degraded"`` distribution was
+        load-shed to a reduced screening-tier sample set and carries no
+        certificates."""
+        return self.submit_montecarlo(case, spec, **kwargs).result(
+            timeout=timeout)
+
     def submit_portfolio(self, spec, *, request_id=None,
                          priority: int = 0,
                          deadline_s: Optional[float] = None) -> Future:

@@ -56,12 +56,16 @@ class QueuedRequest:
     ``"portfolio_shard"`` request is one shard of ANOTHER node's dual
     round (``shard_payload``: site cases + the round's dual-price
     vector), dispatched against this replica's persistent caches — see
-    ``dervet_tpu.portfolio.shard``."""
+    ``dervet_tpu.portfolio.shard``.  A ``"montecarlo"`` request is a
+    batched uncertainty valuation (``mc_case``/``mc_spec`` carry the
+    base case + sampler spec; the MC round answers it directly — see
+    ``dervet_tpu.stochastic``)."""
 
     __slots__ = ("request_id", "cases", "priority", "deadline", "future",
                  "seq", "t_submit", "fingerprint", "kind", "design_case",
                  "design_spec", "design_state", "portfolio_spec",
-                 "shard_payload", "span", "trace_ctx")
+                 "shard_payload", "mc_case", "mc_spec", "span",
+                 "trace_ctx")
 
     def __init__(self, request_id: str, cases: Dict, priority: int = 0,
                  deadline_s: Optional[float] = None, seq: int = 0,
@@ -83,6 +87,8 @@ class QueuedRequest:
         self.design_state = None
         self.portfolio_spec = None
         self.shard_payload = None
+        self.mc_case = None
+        self.mc_spec = None
         # telemetry (dervet_tpu/telemetry): the request's root span on
         # THIS process (ends when the future resolves) and the upstream
         # trace context it was propagated under (fleet transport)

@@ -177,12 +177,21 @@ def cert_policy_tag() -> str:
 
 def key_material(cases: Dict, *, content_digest: Optional[str] = None,
                  tolerance_tag: str = "default",
-                 solver_version: Optional[str] = None) -> Dict[str, str]:
+                 solver_version: Optional[str] = None,
+                 mc_spec=None) -> Dict[str, str]:
     """The full (human-readable) key material for one request.  Stored
     verbatim in each cache entry and re-compared on every hit, so a
-    digest collision can never serve a wrong answer."""
+    digest collision can never serve a wrong answer.
+
+    ``mc_spec`` (a :class:`~dervet_tpu.stochastic.sampler.MCSpec`)
+    folds the Monte-Carlo sampler identity — seed, sample count, shock
+    sigmas, quantile/CVaR request — into the key as an EXTRA field, so
+    two MC requests over the same base case but a different seed or
+    sample count can never collide.  Plain scenario requests omit the
+    field entirely: their key material (and thus every existing cache
+    entry) is byte-identical to before the field existed."""
     from .fleet import structure_fingerprint
-    return {
+    material = {
         "structure": structure_fingerprint(cases),
         "data": (str(content_digest) if content_digest
                  else request_content_digest(cases)),
@@ -191,6 +200,9 @@ def key_material(cases: Dict, *, content_digest: Optional[str] = None,
         "solver_version": (str(solver_version) if solver_version
                            else current_solver_version()),
     }
+    if mc_spec is not None:
+        material["mc"] = json.dumps(mc_spec.normalized(), sort_keys=True)
+    return material
 
 
 def material_key(material: Dict[str, str]) -> str:

@@ -165,6 +165,17 @@ class ScenarioService:
         # the last design screening's per-round stats (the zero-compile
         # warm observable the design smoke gates on)
         self.last_screen_stats: Optional[Dict] = None
+        # monte-carlo request counters (dervet_tpu/stochastic): batched
+        # uncertainty valuations — the sample mass screens through the
+        # design_caches tiers, the quantile-pinning samples certify
+        # through the main solver_cache
+        self._montecarlo = {"requests": 0, "samples": 0,
+                            "certified_samples": 0, "quarantined": 0,
+                            "degraded_answers": 0, "mc_s": 0.0,
+                            "dispatches": 0, "compile_events": 0}
+        # the last MC run's tier mix + per-round dispatch stats (the
+        # zero-compile warm observable the mc smoke gates on)
+        self.last_mc_stats: Optional[Dict] = None
         # backend-loss recovery policy + poison-request registry
         self.recovery = resilience.BackendRecovery(
             max_reinits=backend_max_reinits)
@@ -272,6 +283,36 @@ class ScenarioService:
                            kind="design", design_case=case,
                            design_spec=spec, trace_ctx=trace_ctx)
 
+    def submit_montecarlo(self, case, spec=None, *, request_id=None,
+                          priority: int = 0,
+                          deadline_s: Optional[float] = None,
+                          trace_ctx: Optional[Dict] = None,
+                          **spec_kwargs) -> Future:
+        """Admit one MONTE-CARLO request (uncertainty valuation): sample
+        ``spec.n_samples`` seeded perturbations of ``case``, solve them
+        as one batch (screening mass + certified quantile-pinning
+        re-solves), deliver an
+        :class:`~dervet_tpu.stochastic.distribution.MCDistribution`
+        through the returned future.  Admission semantics (priority,
+        deadline, backpressure, poison blocklist, draining) are
+        identical to :meth:`submit` — an MC request is just another
+        request type.
+
+        ``spec`` is a :class:`~dervet_tpu.stochastic.sampler.MCSpec`;
+        alternatively pass its fields as keyword arguments."""
+        from ..stochastic.sampler import MCSpec
+        from ..stochastic.service import montecarlo_fingerprint
+        if self._draining.is_set():
+            raise ServiceClosedError(
+                "service is draining — no new admissions")
+        if spec is None:
+            spec = MCSpec(**spec_kwargs)
+        spec.validate()       # spec errors raise HERE, at admission
+        fingerprint = montecarlo_fingerprint(case, spec)
+        return self._admit(request_id, fingerprint, priority, deadline_s,
+                           kind="montecarlo", mc_case=case, mc_spec=spec,
+                           trace_ctx=trace_ctx)
+
     def submit_portfolio(self, spec, *, request_id=None,
                          priority: int = 0,
                          deadline_s: Optional[float] = None,
@@ -360,6 +401,7 @@ class ScenarioService:
     def _admit(self, request_id, fingerprint, priority, deadline_s, *,
                cases=None, kind: str = "scenario", design_case=None,
                design_spec=None, portfolio_spec=None, shard_payload=None,
+               mc_case=None, mc_spec=None,
                trace_ctx: Optional[Dict] = None) -> Future:
         """Shared admission tail: backend breaker, poison blocklist,
         id allocation/validation, queue put with typed rejection."""
@@ -405,6 +447,8 @@ class ScenarioService:
         req.design_spec = design_spec
         req.portfolio_spec = portfolio_spec
         req.shard_payload = shard_payload
+        req.mc_case = mc_case
+        req.mc_spec = mc_spec
         # telemetry: the request's root span on this process — a child
         # of the upstream (router) context when one rode the transport,
         # else a fresh root whose trace id derives from the request id
@@ -502,6 +546,19 @@ class ScenarioService:
         case, spec = parse_design_request(payload, base_path=base_path)
         return self.submit_design(case, spec, **kwargs)
 
+    def submit_montecarlo_file(self, path, base_path=None,
+                               **kwargs) -> Future:
+        """Admit a spool ``montecarlo.json`` request file (see
+        ``stochastic.service.parse_montecarlo_request`` for the shape);
+        parse errors raise here, at admission."""
+        import json
+        from ..stochastic.service import parse_montecarlo_request
+        with open(path) as f:
+            payload = json.load(f)
+        case, spec = parse_montecarlo_request(payload,
+                                              base_path=base_path)
+        return self.submit_montecarlo(case, spec, **kwargs)
+
     def submit_portfolio_file(self, path, base_path=None,
                               **kwargs) -> Future:
         """Admit a spool ``portfolio.json`` request file (see
@@ -597,6 +654,16 @@ class ScenarioService:
                        if r.kind == "design"]
         certified = [r for r in certified if r.kind != "design"]
         degraded = [r for r in degraded if r.kind != "design"]
+        # monte-carlo requests run their own round (the engine drives
+        # both tiers' dispatches itself); a load-SHED MC request runs
+        # the screening tier only over a reduced sample count and is
+        # answered degraded — never certificate-stamped
+        mc_shed_ids = {r.request_id for r in degraded
+                       if r.kind == "montecarlo"}
+        mc_reqs = [r for r in certified + degraded
+                   if r.kind == "montecarlo"]
+        certified = [r for r in certified if r.kind != "montecarlo"]
+        degraded = [r for r in degraded if r.kind != "montecarlo"]
         # portfolio requests run their own dual-loop round against the
         # service's persistent caches; a load-SHED portfolio runs the
         # degraded tier (screening inner solves, certification off,
@@ -626,8 +693,8 @@ class ScenarioService:
             try:
                 sr.run()
             except BaseException as e:
-                for req in portfolio_reqs + design_reqs + degraded \
-                        + certified:
+                for req in portfolio_reqs + design_reqs + mc_reqs \
+                        + degraded + certified:
                     if not req.future.done():
                         req.future.set_exception(ServiceClosedError(
                             f"request {req.request_id!r} not "
@@ -655,7 +722,7 @@ class ScenarioService:
                 # preemption); every OTHER request this cycle already
                 # popped from the queue must be answered here or its
                 # client hangs forever
-                for req in design_reqs + degraded + certified:
+                for req in design_reqs + mc_reqs + degraded + certified:
                     if not req.future.done():
                         req.future.set_exception(ServiceClosedError(
                             f"request {req.request_id!r} not "
@@ -681,7 +748,7 @@ class ScenarioService:
                 # preemption); every OTHER request this cycle already
                 # popped from the queue must be answered here or its
                 # client hangs forever
-                for req in design_reqs + degraded + certified:
+                for req in design_reqs + mc_reqs + degraded + certified:
                     if not req.future.done():
                         req.future.set_exception(ServiceClosedError(
                             f"request {req.request_id!r} not dispatched: "
@@ -694,6 +761,33 @@ class ScenarioService:
             self._absorb_design_stats(dr)
             served += len(dr.answered)
             certified = certified + dr.finalist_requests
+        if mc_reqs:
+            from ..stochastic.service import MonteCarloRound
+            mr = MonteCarloRound(mc_reqs, backend=self.backend,
+                                 solver_opts=self.solver_opts,
+                                 caches=self.design_caches,
+                                 final_cache=self.solver_cache,
+                                 degraded_ids=mc_shed_ids,
+                                 supervisor=self.supervisor)
+            try:
+                mr.run()
+            except BaseException as e:
+                # the MC round answers its own requests (incl.
+                # preemption); the scenario tiers below were already
+                # popped from the queue and must be answered here or
+                # their clients hang forever
+                for req in mc_reqs + degraded + certified:
+                    if not req.future.done():
+                        req.future.set_exception(ServiceClosedError(
+                            f"request {req.request_id!r} not "
+                            "dispatched: the monte-carlo round failed "
+                            f"({e}) — resubmit"))
+                        with self._metrics_lock:
+                            self._requests["failed"] += 1
+                self._absorb_mc_stats(mr)
+                raise
+            self._absorb_mc_stats(mr)
+            served += len(mr.answered)
         tiers = [(reqs, is_degraded)
                  for reqs, is_degraded in ((degraded, True),
                                            (certified, False)) if reqs]
@@ -776,6 +870,36 @@ class ScenarioService:
                     self._note_request_telemetry(req, False)
         if dr.last_screen is not None:
             self.last_screen_stats = dr.last_screen
+
+    def _absorb_mc_stats(self, mr) -> None:
+        """Monte-carlo round bookkeeping + request accounting (the round
+        answers every future itself)."""
+        st = mr.stats
+        with self._metrics_lock:
+            self._montecarlo["requests"] += int(st.get("requests", 0))
+            self._montecarlo["samples"] += int(st.get("samples", 0))
+            self._montecarlo["certified_samples"] += int(
+                st.get("certified_samples", 0))
+            self._montecarlo["quarantined"] += int(
+                st.get("quarantined", 0))
+            self._montecarlo["degraded_answers"] += int(
+                st.get("degraded", 0))
+            self._montecarlo["mc_s"] += float(st.get("mc_s", 0.0))
+            self._montecarlo["dispatches"] += int(st.get("dispatches", 0))
+            self._montecarlo["compile_events"] += int(
+                st.get("compile_events", 0))
+            for req in mr.answered:
+                fut = req.future
+                if fut.done() and fut.exception() is None:
+                    self._requests["completed"] += 1
+                    self._latencies.append(
+                        time.monotonic() - req.t_submit)
+                    self._note_request_telemetry(req, True)
+                elif fut.done():
+                    self._requests["failed"] += 1
+                    self._note_request_telemetry(req, False)
+        if mr.last_mc is not None:
+            self.last_mc_stats = mr.last_mc
 
     def _absorb_shard_stats(self, sr) -> None:
         """Portfolio-shard-round bookkeeping + request accounting (the
@@ -1010,12 +1134,17 @@ class ScenarioService:
             requests = dict(self._requests)
             design = dict(self._design)
             portfolio = dict(self._portfolio)
+            montecarlo = dict(self._montecarlo)
             elastic = dict(self._elastic)
         design["screen_s"] = round(design["screen_s"], 3)
         design["screen_candidates_per_s"] = round(
             design["candidates"] / design["screen_s"], 2) \
             if design["screen_s"] else None
         design["caches"] = self.design_caches.snapshot()
+        montecarlo["mc_s"] = round(montecarlo["mc_s"], 3)
+        montecarlo["samples_per_s"] = round(
+            montecarlo["samples"] / montecarlo["mc_s"], 2) \
+            if montecarlo["mc_s"] else None
         groups = rounds.pop("batch_sum"), rounds["device_groups"]
         cache = self.solver_cache
         lookups = cache.builds + cache.hits
@@ -1038,6 +1167,10 @@ class ScenarioService:
                                  else v)
                              for k, v in portfolio.items()},
                           "last": self.last_portfolio},
+            # monte-carlo uncertainty valuations (dervet_tpu/stochastic):
+            # sample volume, tier mix, and the last run's per-round
+            # dispatch stats (the zero-compile warm observable)
+            "monte_carlo": {**montecarlo, "last": self.last_mc_stats},
             "batch_occupancy": {
                 "mean_windows_per_device_batch":
                     round(groups[0] / groups[1], 2) if groups[1] else 0.0,
@@ -1423,22 +1556,29 @@ def serve_main(argv=None) -> int:
                         faultinject.maybe_replica_crash(admissions)
                         continue
                     # a JSON file with a top-level "design" object is a
-                    # BOOST design request; one with a top-level
-                    # "portfolio" object is a coupled-fleet request —
+                    # BOOST design request; "portfolio" a coupled-fleet
+                    # request; "montecarlo" an uncertainty valuation —
                     # anything else is a model-parameters file
-                    is_design = is_portfolio = False
+                    is_design = is_portfolio = is_mc = False
                     if path.suffix.lower() == ".json":
                         from ..design.service import is_design_payload
                         from ..portfolio.service import \
                             is_portfolio_payload
+                        from ..stochastic.service import \
+                            is_montecarlo_payload
                         try:
                             with open(path) as fh:
                                 payload = json.load(fh)
                             is_design = is_design_payload(payload)
                             is_portfolio = is_portfolio_payload(payload)
+                            is_mc = is_montecarlo_payload(payload)
                         except Exception:
-                            is_design = is_portfolio = False
-                    if is_portfolio:
+                            is_design = is_portfolio = is_mc = False
+                    if is_mc:
+                        fut = service.submit_montecarlo_file(
+                            path, base_path=args.base_path,
+                            request_id=rid)
+                    elif is_portfolio:
                         fut = service.submit_portfolio_file(
                             path, base_path=args.base_path,
                             request_id=rid)

@@ -177,6 +177,7 @@ EVENT_STRAGGLER = "straggler"       # one device's solves slowed (elastic)
 EVENT_REPLICA_CRASH = "replica_crash"   # serve loop hard-exits (SIGKILL-like)
 EVENT_REPLICA_HANG = "replica_hang"     # serve loop sleeps; heartbeats stop
 EVENT_DIVERGING_DUALS = "diverging_duals"  # portfolio dual update corrupted
+EVENT_BAD_SAMPLE = "bad_sample"     # one MC sampled trajectory NaN-poisoned
 
 
 class InjectedCrashError(RuntimeError):
@@ -228,7 +229,8 @@ class FaultPlan:
                  replica_hang_after: Optional[int] = None,
                  replica_hang_seconds: float = 3600.0,
                  diverge_duals_round: Optional[int] = None,
-                 diverge_duals_scale: float = 25.0):
+                 diverge_duals_scale: float = 25.0,
+                 bad_sample: Iterable = ()):
         self.nonconverge = _norm(nonconverge)
         self.rungs = _norm(rungs)
         self.poison_cases = _norm(poison_cases)
@@ -298,6 +300,12 @@ class FaultPlan:
                                     else int(diverge_duals_round))
         self.diverge_duals_scale = float(diverge_duals_scale)
         self._diverge_fired = False
+        # bad_sample (the MC drill): NaN-poison the SAMPLED trajectory of
+        # the targeted Monte-Carlo sample indices — the pre-dispatch
+        # input guards must quarantine exactly those samples (with the
+        # sample-labeled case id in the diagnostic) while the rest of
+        # the batch completes
+        self.bad_sample = _norm(bad_sample)
         self._preempt_fired = False
         self.fired: List[Tuple[str, str]] = []   # (rung/event, label/case)
 
@@ -421,6 +429,14 @@ class FaultPlan:
         self.fired.append((EVENT_DIVERGING_DUALS, str(round_idx)))
         return True
 
+    def bad_sample_due(self, sample_idx) -> bool:
+        """Should Monte-Carlo sample ``sample_idx``'s trajectory be
+        NaN-poisoned?"""
+        if _match(self.bad_sample, sample_idx):
+            self.fired.append((EVENT_BAD_SAMPLE, str(sample_idx)))
+            return True
+        return False
+
     def preempt_due(self, batches_done: int) -> bool:
         if self.preempt_after is None or self._preempt_fired or \
                 batches_done < self.preempt_after:
@@ -455,7 +471,9 @@ _ENV_VARS = ("DERVET_TPU_FAULT_NONCONVERGE", "DERVET_TPU_FAULT_POISON_CASE",
              "DERVET_TPU_FAULT_REPLICA_HANG",
              "DERVET_TPU_FAULT_REPLICA_HANG_S",
              "DERVET_TPU_FAULT_DIVERGE_DUALS",
-             "DERVET_TPU_FAULT_DIVERGE_DUALS_SCALE")
+             "DERVET_TPU_FAULT_DIVERGE_DUALS_SCALE",
+             "DERVET_TPU_FAULT_BAD_SAMPLE",
+             "DERVET_TPU_FAULT_BAD_SAMPLE_IDX")
 _ENV_PLAN: Optional[FaultPlan] = None
 _ENV_SNAPSHOT: Optional[tuple] = None
 
@@ -479,9 +497,23 @@ def _plan_from_env() -> Optional[FaultPlan]:
     rcr = os.environ.get("DERVET_TPU_FAULT_REPLICA_CRASH")
     rhg = os.environ.get("DERVET_TPU_FAULT_REPLICA_HANG")
     dd = os.environ.get("DERVET_TPU_FAULT_DIVERGE_DUALS")
+    bs = os.environ.get("DERVET_TPU_FAULT_BAD_SAMPLE", "").strip().lower()
+    bs_on = bs not in ("", "0", "false", "off")
     if not (nc or pc or cf or hg or sl or pa or cr or ov_on or dl_on
-            or crash or ss or st_on or rcr or rhg or dd):
+            or crash or ss or st_on or rcr or rhg or dd or bs_on):
         return None
+    # bad_sample targets: the _IDX knob wins; else the BAD_SAMPLE value
+    # itself when it names indices ("3" / "3,7" / "all"); a plain
+    # boolean-truthy value ("1"/"true"/"on") defaults to sample 0
+    bs_idx = os.environ.get("DERVET_TPU_FAULT_BAD_SAMPLE_IDX")
+    if not bs_on:
+        bad_sample = ()
+    elif bs_idx:
+        bad_sample = bs_idx
+    elif bs in ("1", "true", "on", "yes"):
+        bad_sample = "0"
+    else:
+        bad_sample = bs
     ov_n = os.environ.get("DERVET_TPU_FAULT_OVERLOAD_N")
     rungs = os.environ.get("DERVET_TPU_FAULT_RUNGS", RUNG_SOLVE)
     return FaultPlan(
@@ -517,7 +549,8 @@ def _plan_from_env() -> Optional[FaultPlan]:
             os.environ.get("DERVET_TPU_FAULT_REPLICA_HANG_S", 3600)),
         diverge_duals_round=int(dd) if dd else None,
         diverge_duals_scale=float(
-            os.environ.get("DERVET_TPU_FAULT_DIVERGE_DUALS_SCALE", 25.0)))
+            os.environ.get("DERVET_TPU_FAULT_DIVERGE_DUALS_SCALE", 25.0)),
+        bad_sample=bad_sample)
 
 
 def get_plan() -> Optional[FaultPlan]:
@@ -706,6 +739,21 @@ def maybe_diverge_duals(round_idx: int, price: np.ndarray
                         f"diverge_duals|{round_idx}",
                         plan.diverge_duals_scale)
     return np.maximum(bad, 0.0)
+
+
+def maybe_bad_sample(sample_idx, frame) -> bool:
+    """``bad_sample`` injection point inside the Monte-Carlo sampler:
+    when sample ``sample_idx`` is targeted, NaN-poison the head of its
+    freshly sampled time-series frame (in place) — corrupted upstream
+    data for exactly one sample of the batch.  The pre-dispatch input
+    guards must quarantine that sample (its ``mc.sNNNNN`` case id names
+    it in the diagnostic) while every other sample completes."""
+    plan = get_plan()
+    if plan is None or not plan.bad_sample_due(sample_idx):
+        return False
+    n = max(1, len(frame) // 16)
+    frame.iloc[:n, 0] = np.nan
+    return True
 
 
 def maybe_preempt(batches_done: int) -> bool:

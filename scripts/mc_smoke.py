@@ -1,0 +1,132 @@
+"""CI smoke: the Monte-Carlo uncertainty product on cpu XLA, no chip.
+
+Boots a :class:`~dervet_tpu.service.server.ScenarioService`
+(backend="jax" on a CPU XLA device — the same no-hardware analogue the
+serve/design smokes use), submits one 1024-sample Monte-Carlo valuation
+request, and asserts the uncertainty contract:
+
+* the sample mass solved through TWO dispatch rounds (screening tier +
+  certified quantile-pinning tier) with the device-dispatch count far
+  below one-dispatch-per-sample (the batch-axis win);
+* every quantile-pinning sample carries an accepted PR-4 float64
+  certificate, and the screening mass was never certificate-stamped;
+* a WARM repeat of the same request compiles ZERO XLA programs
+  (ledger-gated) and serializes a BYTE-IDENTICAL
+  ``mc_distribution.json`` — the fixed-seed determinism contract;
+* a degraded (load-shed tier) answer is marked, hints resubmission, and
+  carries no certificates anywhere.
+
+Env knobs: SMOKE_SAMPLES (default 1024), SMOKE_HOURS (default 72).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def make_case(hours: int):
+    from dervet_tpu.benchlib import synthetic_case
+    c = synthetic_case()
+    c.scenario["allow_partial_year"] = True
+    c.datasets.time_series = c.datasets.time_series.iloc[:hours]
+    return c
+
+
+def main() -> int:
+    from dervet_tpu.service import ScenarioService
+    from dervet_tpu.stochastic import MCSpec, run_montecarlo
+
+    samples = int(os.environ.get("SMOKE_SAMPLES", "1024"))
+    hours = int(os.environ.get("SMOKE_HOURS", "72"))
+    spec = MCSpec(n_samples=samples, seed=7)
+
+    svc = ScenarioService(backend="jax", max_wait_s=0.05)
+    svc.start()
+    try:
+        res = svc.submit_montecarlo(make_case(hours), spec,
+                                    request_id="smoke-mc").result(
+                                        timeout=3600)
+        # -- gates -----------------------------------------------------
+        if res.stats["n"] < samples - res.tier_mix["quarantined"]:
+            raise AssertionError(
+                f"published {res.stats['n']} of {samples} samples")
+        tiers = [r["tier"] for r in res.engine["rounds"]]
+        if tiers != ["screening", "certified"]:
+            raise AssertionError(
+                f"expected one screening + one certified round, got "
+                f"{tiers}")
+        if not res.pinning_all_certified:
+            raise AssertionError(
+                "not every quantile-pinning sample certified:\n"
+                + res.samples[res.samples["tier"] == "certified"][
+                    ["sample", "certified", "reason"]].to_string())
+        if res.engine["certification_stamped_screening"]:
+            raise AssertionError(
+                "a screening-tier sample was certificate-stamped — the "
+                "thread-local cert-off override leaked")
+        dispatches = res.engine["dispatches"]
+        if dispatches * 10 > samples:
+            raise AssertionError(
+                f"{dispatches} device dispatches for {samples} samples "
+                "— less than the 10x batching win over solo solves")
+        cold_compiles = res.engine["compile_events"]
+
+        # -- warm repeat: zero compiles, byte-identical ----------------
+        warm = svc.submit_montecarlo(make_case(hours), spec,
+                                     request_id="smoke-mc").result(
+                                         timeout=3600)
+        if warm.engine["compile_events"]:
+            raise AssertionError(
+                f"warm repeat compiled {warm.engine['compile_events']} "
+                "program(s) — compiles must amortize to zero after "
+                "round 1")
+        if warm.to_json() != res.to_json():
+            raise AssertionError(
+                "fixed-seed warm repeat is not byte-identical")
+
+        # -- degraded tier: never cert-stamped -------------------------
+        os.environ["DERVET_TPU_MC_DEGRADED_SAMPLES"] = "64"
+        shed = run_montecarlo(make_case(hours), spec, backend="jax",
+                              certify_tier=False)
+        if shed.fidelity != "degraded" or not shed.resubmit_hint:
+            raise AssertionError("shed answer not marked degraded")
+        if shed.samples["certified"].any() or \
+                shed.engine["certification_stamped_screening"]:
+            raise AssertionError(
+                "a degraded answer carried a certificate")
+        m = svc.metrics()
+    finally:
+        svc.drain()
+
+    print(json.dumps({
+        "smoke": "monte_carlo", "ok": True,
+        "samples": samples,
+        "tier_mix": res.tier_mix,
+        "dispatches": int(dispatches),
+        "solo_dispatch_floor": int(samples),
+        "batching_win_x": round(samples / max(1, dispatches), 1),
+        "cold_compile_events": int(cold_compiles),
+        "warm_compile_events": int(warm.engine["compile_events"]),
+        "samples_per_s_screening":
+            res.engine["samples_per_s_screening"],
+        "samples_per_s_certified":
+            res.engine["samples_per_s_certified"],
+        "stats": {k: res.stats[k] for k in
+                  ("mean", "var_alpha", "cvar_alpha")},
+        "mc_metrics": {k: m["monte_carlo"][k] for k in
+                       ("requests", "samples", "certified_samples",
+                        "quarantined")},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,561 @@
+"""Monte-Carlo uncertainty product: seeded sampler, distribution math,
+the two-tier batched valuation engine, the serving surface, and the
+risk-aware design frontier.
+
+The contract under test:
+
+* the sampler is a PURE function of (seed, sample index) — same draws
+  across runs, processes, and generation order — and shares every
+  reference frame except ``time_series`` across the population;
+* quantiles and CVaR are float64 HOST math, re-derivable to 1e-9 from
+  the published per-sample vector by an independent implementation;
+* a fixed seed yields a byte-identical ``mc_distribution.json`` across
+  reruns AND across solve-batch orderings, with zero compile events
+  once the caches are warm;
+* the quantile-pinning samples re-solve fully certified while the
+  screening mass is never certificate-stamped; a load-shed (degraded)
+  answer carries no certificates and says so;
+* the ``bad_sample`` fault kind quarantines exactly the poisoned
+  sample — labeled by sample index — while the rest of the batch
+  completes;
+* MC requests fold their sampler identity into the request-cache key,
+  ride the service front door end to end, and serve from the spool;
+* ``DesignSpec.risk`` adds per-finalist MC columns and a (capex,
+  E[value], CVaR) Pareto axis to the certified design frontier.
+"""
+import json
+import math
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from dervet_tpu.benchlib import synthetic_case
+from dervet_tpu.design import DERBounds, DesignSpec, dominated_mask, \
+    run_design
+from dervet_tpu.design.screen import ScreeningCaches
+from dervet_tpu.scenario.scenario import SolverCache
+from dervet_tpu.service import (QueueFullError, ScenarioClient,
+                                ScenarioService)
+from dervet_tpu.service.queue import QueuedRequest
+from dervet_tpu.stochastic import (MCDistribution, MCSpec, cvar,
+                                   distribution_stats, run_montecarlo,
+                                   sample_case, sample_seed)
+from dervet_tpu.stochastic.distribution import pinning_positions
+from dervet_tpu.stochastic.sampler import mc_spec_from_dict
+from dervet_tpu.stochastic.service import (MonteCarloRound,
+                                           is_montecarlo_payload,
+                                           montecarlo_fingerprint,
+                                           parse_montecarlo_request)
+from dervet_tpu.utils import faultinject
+from dervet_tpu.utils.errors import ParameterError
+
+
+def _case(hours: int = 72, seed: int = 0):
+    c = synthetic_case(seed=seed)
+    c.scenario["allow_partial_year"] = True
+    c.datasets.time_series = c.datasets.time_series.iloc[:hours]
+    return c
+
+
+def _spec(**over):
+    base = dict(n_samples=8, seed=3, alpha=0.75, quantiles=(0.5,),
+                screen_tier=0)
+    base.update(over)
+    return MCSpec(**base)
+
+
+# ---------------------------------------------------------------------------
+# Sampler: determinism + frame sharing
+# ---------------------------------------------------------------------------
+
+class TestSampler:
+    def test_sample_seed_is_pure_and_independent(self):
+        assert sample_seed(0, 7) == sample_seed(0, 7)
+        assert sample_seed(0, 7) != sample_seed(0, 8)
+        assert sample_seed(0, 7) != sample_seed(1, 7)
+
+    def test_samples_deterministic_across_generation_order(self):
+        case = _case()
+        spec = _spec()
+        a = sample_case(case, spec, 5).datasets.time_series
+        # generate other samples in between: no sequential RNG state
+        sample_case(case, spec, 0)
+        sample_case(case, spec, 11)
+        b = sample_case(case, spec, 5).datasets.time_series
+        assert a.equals(b)
+        c = sample_case(case, spec, 6).datasets.time_series
+        assert not a.equals(c)
+
+    def test_perturbation_model_touches_the_right_columns(self):
+        case = _case()
+        base = case.datasets.time_series
+        s = sample_case(case, _spec(seed=9), 0).datasets.time_series
+        assert not np.allclose(s["DA Price ($/kWh)"],
+                               base["DA Price ($/kWh)"])
+        assert not np.allclose(s["Site Load (kW)"], base["Site Load (kW)"])
+        # solar availability is one multiplicative factor in [0, 1]
+        gen_b = base["PV Gen (kW/rated kW)"].to_numpy()
+        gen_s = s["PV Gen (kW/rated kW)"].to_numpy()
+        nz = gen_b > 0
+        ratios = gen_s[nz] / gen_b[nz]
+        assert np.allclose(ratios, ratios[0])
+        assert 0.0 <= ratios[0] <= 1.0
+        # nothing goes negative
+        assert (s["DA Price ($/kWh)"] >= 0).all()
+        assert (s["Site Load (kW)"] >= 0).all()
+
+    def test_frames_shared_except_time_series(self):
+        case = _case()
+        s = sample_case(case, _spec(), 0)
+        assert s.datasets.time_series is not case.datasets.time_series
+        assert s.datasets.monthly is case.datasets.monthly
+        assert s.datasets.tariff is case.datasets.tariff
+        # the base frame is never mutated
+        assert case.datasets.time_series.equals(
+            _case().datasets.time_series)
+
+    def test_spec_validation(self):
+        with pytest.raises(ParameterError, match="n_samples"):
+            _spec(n_samples=1).validate()
+        with pytest.raises(ParameterError, match="alpha"):
+            _spec(alpha=1.0).validate()
+        with pytest.raises(ParameterError, match="quantile"):
+            _spec(quantiles=(0.5, 1.5)).validate()
+        with pytest.raises(ParameterError, match="price_sigma"):
+            _spec(price_sigma=-0.1).validate()
+        with pytest.raises(ParameterError, match="screen_tier"):
+            _spec(screen_tier=99).validate()
+
+    def test_sample_cap_env(self, monkeypatch):
+        monkeypatch.setenv("DERVET_TPU_MC_MAX_SAMPLES", "16")
+        with pytest.raises(ParameterError, match="cap"):
+            _spec(n_samples=17).validate()
+        _spec(n_samples=16).validate()
+
+    def test_spec_from_dict_surface(self):
+        spec = mc_spec_from_dict({"samples": 64, "seed": 2,
+                                  "quantiles": [0.1, 0.9]})
+        assert spec.n_samples == 64 and spec.seed == 2
+        assert spec.quantiles == (0.1, 0.9)
+        with pytest.raises(ParameterError, match="unknown field"):
+            mc_spec_from_dict({"sample_count": 64})
+        with pytest.raises(ParameterError, match="object"):
+            mc_spec_from_dict("64")
+
+    def test_normalized_includes_seed_and_count(self):
+        a = _spec(seed=1).normalized()
+        b = _spec(seed=2).normalized()
+        assert a != b
+        assert a["seed"] == 1 and a["n_samples"] == 8
+
+
+# ---------------------------------------------------------------------------
+# Distribution math: float64 host recompute to 1e-9
+# ---------------------------------------------------------------------------
+
+def _manual_quantile(values, q):
+    """Independent linear-interpolation quantile (pure python float)."""
+    s = sorted(float(v) for v in values)
+    pos = q * (len(s) - 1)
+    lo, hi = int(math.floor(pos)), int(math.ceil(pos))
+    frac = pos - lo
+    return s[lo] * (1.0 - frac) + s[hi] * frac
+
+
+def _manual_cvar(values, alpha):
+    s = sorted(float(v) for v in values)
+    k = max(1, int(math.ceil(round((1.0 - alpha) * len(s), 12))))
+    tail = s[-k:]
+    return sum(tail) / len(tail)
+
+
+class TestDistributionMath:
+    def test_stats_match_independent_recompute(self):
+        rng = np.random.default_rng(42)
+        v = rng.normal(1e4, 2e3, size=257)
+        stats = distribution_stats(v, 0.95, (0.05, 0.5, 0.95))
+        for q in (0.05, 0.5, 0.95):
+            assert stats["quantiles"][f"p{100 * q:g}"] == pytest.approx(
+                _manual_quantile(v, q), rel=1e-9)
+        assert stats["var_alpha"] == pytest.approx(
+            _manual_quantile(v, 0.95), rel=1e-9)
+        assert stats["cvar_alpha"] == pytest.approx(
+            _manual_cvar(v, 0.95), rel=1e-9)
+        assert stats["mean"] == pytest.approx(sum(v) / v.size, rel=1e-9)
+        assert stats["n"] == 257
+
+    def test_cvar_tail_definition(self):
+        v = [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0, 9.0, 10.0]
+        # alpha=0.8 over 10 samples: worst ceil(2) = {9, 10}
+        assert cvar(v, 0.8) == pytest.approx(9.5)
+        # alpha=0.95 of 10 -> ceil(0.5) = 1 worst sample
+        assert cvar(v, 0.95) == pytest.approx(10.0)
+        # the decimal-rounding guard: 0.95 of 1024 must be 52, not 51
+        n = 1024
+        k = max(1, int(math.ceil(round((1.0 - 0.95) * n, 12))))
+        assert k == 52
+
+    def test_pinning_positions_cover_quantiles_and_tail(self):
+        rng = np.random.default_rng(7)
+        v = rng.normal(size=100)
+        picks = pinning_positions(v, (0.5,), 0.9)
+        order = np.argsort(v, kind="stable")
+        # the median's bracketing order statistics are pinned
+        assert int(order[49]) in picks and int(order[50]) in picks
+        # the whole CVaR tail (worst 10) is pinned
+        for i in order[-10:]:
+            assert int(i) in picks
+        assert picks == sorted(picks)
+
+
+# ---------------------------------------------------------------------------
+# Request-cache key material folds the sampler identity
+# ---------------------------------------------------------------------------
+
+class TestRequestCacheKeys:
+    def test_mc_spec_distinguishes_cache_keys(self):
+        from dervet_tpu.service import reqcache
+        cases = {0: _case()}
+        m0 = reqcache.key_material(cases)
+        m_seed1 = reqcache.key_material(cases, mc_spec=_spec(seed=1))
+        m_seed2 = reqcache.key_material(cases, mc_spec=_spec(seed=2))
+        m_n16 = reqcache.key_material(cases,
+                                      mc_spec=_spec(seed=1, n_samples=16))
+        # a plain scenario request's material is UNCHANGED (no mc field
+        # -> existing cache entries stay addressable)
+        assert "mc" not in m0
+        assert {k: v for k, v in m_seed1.items() if k != "mc"} == m0
+        # seed and sample count each produce a distinct key
+        keys = {reqcache.material_key(m)
+                for m in (m0, m_seed1, m_seed2, m_n16)}
+        assert len(keys) == 4
+
+    def test_montecarlo_fingerprint_keys_on_seed(self):
+        case = _case()
+        assert montecarlo_fingerprint(case, _spec(seed=1)) != \
+            montecarlo_fingerprint(case, _spec(seed=2))
+        assert montecarlo_fingerprint(case, _spec(seed=1)) == \
+            montecarlo_fingerprint(case, _spec(seed=1))
+
+
+# ---------------------------------------------------------------------------
+# Engine: determinism, tiering, faults (cpu XLA dispatches)
+# ---------------------------------------------------------------------------
+
+class TestEngine:
+    def test_distribution_deterministic_and_order_invariant(self):
+        """Fixed seed => byte-identical mc_distribution.json across
+        reruns AND across solve-batch orderings; warm reruns on shared
+        caches compile nothing."""
+        case = _case()
+        spec = _spec()
+        caches = ScreeningCaches(pad_grid=True)
+        final = SolverCache(pad_grid=True, memory=caches.memory)
+
+        def run(**kw):
+            return run_montecarlo(case, spec, backend="jax",
+                                  caches=caches, final_cache=final,
+                                  request_id="det", **kw)
+
+        r1 = run()
+        r2 = run()
+        r3 = run(sample_order=list(reversed(range(spec.n_samples))))
+        assert r1.to_json() == r2.to_json() == r3.to_json()
+        # compiles amortize to zero on the shared caches
+        assert r2.engine["compile_events"] == 0
+        assert r3.engine["compile_events"] == 0
+        # the pinning samples all certified; the screening mass never
+        # got a certificate stamped
+        assert r1.pinning_all_certified
+        assert not r1.engine["certification_stamped_screening"]
+        assert r1.fidelity == "certified"
+        assert r1.tier_mix["certified"] >= 2
+        assert r1.tier_mix["screening"] + r1.tier_mix["certified"] == \
+            spec.n_samples
+        # exactly one dispatch round per tier
+        assert [r["tier"] for r in r1.engine["rounds"]] == \
+            ["screening", "certified"]
+        # health + ledger ride the result contract
+        assert r1.run_health["monte_carlo"]["tier_mix"] == r1.tier_mix
+        assert r1.solve_ledger is not None
+
+    def test_byte_identity_survives_tight_warmstart_cap(
+            self, monkeypatch):
+        """A warm-start LRU smaller than the batch must not break the
+        fixed-seed replay contract: the engine raises the cap so every
+        window of the batch stays resident (an evicted window would
+        re-converge near-grade on the repeat, landing on a slightly
+        different objective within the screening tolerance)."""
+        monkeypatch.setenv("DERVET_TPU_WARMSTART_CAP", "2")
+        case = _case()
+        spec = _spec()
+        caches = ScreeningCaches(pad_grid=True)
+        final = SolverCache(pad_grid=True, memory=caches.memory)
+        r1 = run_montecarlo(case, spec, backend="jax", caches=caches,
+                            final_cache=final, request_id="cap")
+        r2 = run_montecarlo(case, spec, backend="jax", caches=caches,
+                            final_cache=final, request_id="cap")
+        assert caches.memory.max_entries >= 2 * spec.n_samples
+        assert r1.to_json() == r2.to_json()
+
+    def test_stats_recompute_from_published_samples(self):
+        """The published stats re-derive to 1e-9 from the published
+        per-sample objectives alone (float64 host math, no hidden
+        state)."""
+        case = _case()
+        r = run_montecarlo(case, _spec(seed=5), backend="jax")
+        v = [row["objective"]
+             for row in r.as_dict()["samples"]
+             if row["objective"] is not None]
+        assert len(v) == r.stats["n"]
+        assert r.stats["quantiles"]["p50"] == pytest.approx(
+            _manual_quantile(v, 0.5), rel=1e-9)
+        assert r.stats["var_alpha"] == pytest.approx(
+            _manual_quantile(v, 0.75), rel=1e-9)
+        assert r.stats["cvar_alpha"] == pytest.approx(
+            _manual_cvar(v, 0.75), rel=1e-9)
+        assert r.stats["mean"] == pytest.approx(sum(v) / len(v),
+                                                rel=1e-9)
+
+    def test_degraded_contract(self, monkeypatch):
+        """certify_tier=False: reduced sample count, degraded mark,
+        resubmit hint, and NOTHING certificate-stamped."""
+        monkeypatch.setenv("DERVET_TPU_MC_DEGRADED_SAMPLES", "4")
+        case = _case()
+        r = run_montecarlo(case, _spec(n_samples=8), backend="jax",
+                           certify_tier=False)
+        assert r.fidelity == "degraded"
+        assert r.stats["n"] == 4
+        assert "resubmit" in r.resubmit_hint
+        assert not r.samples["certified"].any()
+        assert (r.samples["tier"] == "screening").all()
+        assert not r.pinning_all_certified
+        assert r.tier_mix["certified"] == 0
+        assert not r.engine["certification_stamped_screening"]
+
+    def test_bad_sample_fault_quarantines_exactly_that_sample(
+            self, monkeypatch):
+        monkeypatch.setenv("DERVET_TPU_FAULT_BAD_SAMPLE", "1")
+        monkeypatch.setenv("DERVET_TPU_FAULT_BAD_SAMPLE_IDX", "3")
+        case = _case()
+        r = run_montecarlo(case, _spec(n_samples=6), backend="jax")
+        bad = r.samples[r.samples["sample"] == 3].iloc[0]
+        assert bool(bad.quarantined)
+        assert "sample 3" in bad.reason
+        # the rest of the batch completed and published
+        good = r.samples[r.samples["sample"] != 3]
+        assert not good["quarantined"].any()
+        assert np.isfinite(good["objective"]).all()
+        assert r.stats["n"] == 5
+        assert r.tier_mix["quarantined"] == 1
+        assert r.pinning_all_certified
+
+    def test_sample_order_must_be_permutation(self):
+        with pytest.raises(ParameterError, match="permutation"):
+            run_montecarlo(_case(), _spec(n_samples=4), backend="jax",
+                           sample_order=[0, 1, 2, 2])
+
+
+class TestBadSampleFaultPlan:
+    def test_env_parsing_and_injection(self, monkeypatch):
+        monkeypatch.setenv("DERVET_TPU_FAULT_BAD_SAMPLE", "1")
+        monkeypatch.setenv("DERVET_TPU_FAULT_BAD_SAMPLE_IDX", "2,5")
+        plan = faultinject.get_plan()
+        assert plan.bad_sample_due(2) and plan.bad_sample_due(5)
+        assert not plan.bad_sample_due(0)
+        import pandas as pd
+        frame = pd.DataFrame({"x": np.ones(32)})
+        assert faultinject.maybe_bad_sample(2, frame)
+        assert frame["x"].isna().any()
+        clean = pd.DataFrame({"x": np.ones(32)})
+        assert not faultinject.maybe_bad_sample(0, clean)
+        assert not clean["x"].isna().any()
+
+    def test_plain_boolean_targets_sample_zero(self, monkeypatch):
+        monkeypatch.setenv("DERVET_TPU_FAULT_BAD_SAMPLE", "1")
+        plan = faultinject.get_plan()
+        assert plan.bad_sample_due(0)
+        assert not plan.bad_sample_due(1)
+
+
+# ---------------------------------------------------------------------------
+# Serving surface: service round, shed tier, spool, client, CLI
+# ---------------------------------------------------------------------------
+
+class TestMonteCarloService:
+    def test_submit_montecarlo_end_to_end(self, tmp_path):
+        svc = ScenarioService(backend="jax", max_wait_s=0.0)
+        fut = svc.submit_montecarlo(_case(), _spec(), request_id="m1")
+        assert svc.run_once() == 1
+        res = fut.result(0)
+        assert isinstance(res, MCDistribution)
+        assert res.request_id == "m1"
+        assert res.fidelity == "certified"
+        assert res.pinning_all_certified
+        assert res.request_latency_s is not None
+        m = svc.metrics()["monte_carlo"]
+        assert m["requests"] == 1 and m["samples"] == 8
+        assert m["certified_samples"] == res.tier_mix["certified"]
+        assert m["last"]["request_id"] == "m1"
+        # warm repeat of the SAME request id is byte-identical
+        fut2 = svc.submit_montecarlo(_case(), _spec(), request_id="m1")
+        svc.run_once()
+        assert fut2.result(0).to_json() == res.to_json()
+        assert svc.metrics()["monte_carlo"]["last"]["compile_events"] == 0
+        # artifacts serialize atomically and round-trip
+        res.save_as_csv(tmp_path)
+        payload = json.loads(
+            (tmp_path / "mc_distribution.json").read_text())
+        assert payload == res.as_dict()
+        assert (tmp_path / "mc_samples.csv").exists()
+        svc.close()
+
+    def test_spec_kwargs_submission_and_validation(self):
+        svc = ScenarioService(backend="jax", max_wait_s=0.0)
+        with pytest.raises(ParameterError, match="n_samples"):
+            svc.submit_montecarlo(_case(), n_samples=1)
+        fut = svc.submit_montecarlo(_case(), n_samples=8, seed=3,
+                                    alpha=0.75, quantiles=(0.5,),
+                                    request_id="kw")
+        svc.run_once()
+        assert fut.result(0).spec["n_samples"] == 8
+        svc.close()
+
+    def test_shed_montecarlo_degraded_never_stamped(self, monkeypatch):
+        """A load-shed MC request answers from a reduced screening-only
+        sample set, marked degraded, zero certificates."""
+        monkeypatch.setenv("DERVET_TPU_MC_DEGRADED_SAMPLES", "4")
+        req = QueuedRequest("shed1", {}, kind="montecarlo")
+        req.mc_case = _case()
+        req.mc_spec = _spec(n_samples=8)
+        mr = MonteCarloRound([req], backend="jax",
+                             degraded_ids={"shed1"})
+        mr.run()
+        res = req.future.result(0)
+        assert res.fidelity == "degraded"
+        assert res.stats["n"] == 4
+        assert not res.samples["certified"].any()
+        assert "resubmit" in res.resubmit_hint
+        assert mr.stats["degraded"] == 1
+
+    def test_round_answers_failed_request_and_continues(self):
+        """One poisoned request must not leak its future or take the
+        round down — the next request still answers."""
+        bad = QueuedRequest("bad", {}, kind="montecarlo")
+        bad.mc_case = _case()
+        # a spec that fails validation inside the engine
+        bad.mc_spec = MCSpec(n_samples=1)
+        ok = QueuedRequest("ok", {}, kind="montecarlo")
+        ok.mc_case = _case()
+        ok.mc_spec = _spec()
+        mr = MonteCarloRound([bad, ok], backend="jax")
+        mr.run()
+        with pytest.raises(ParameterError):
+            bad.future.result(0)
+        assert ok.future.result(0).fidelity == "certified"
+
+    def test_spool_payload_detection_and_parse_errors(self):
+        assert is_montecarlo_payload({"montecarlo": {"samples": 8}})
+        assert not is_montecarlo_payload({"design": {}})
+        assert not is_montecarlo_payload([1, 2])
+        with pytest.raises(ParameterError, match="parameters"):
+            parse_montecarlo_request({"montecarlo": {"samples": 8}})
+        with pytest.raises(ParameterError, match="object"):
+            parse_montecarlo_request({"montecarlo": 3})
+
+    def test_client_retry_surface(self):
+        class _Stub:
+            def __init__(self):
+                self.calls = 0
+
+            def submit_montecarlo(self, case, spec=None, **kw):
+                self.calls += 1
+                if self.calls == 1:
+                    raise QueueFullError("full", retry_after_s=0.0)
+                f = Future()
+                f.set_result("dist")
+                return f
+
+        stub = _Stub()
+        client = ScenarioClient(stub, jitter_seed=0)
+        assert client.montecarlo(None) == "dist"
+        assert stub.calls == 2
+
+    def test_cli_parser_maps_flags(self):
+        from dervet_tpu.stochastic.cli import _quantiles, build_parser
+        args = build_parser().parse_args(
+            ["case.csv", "--samples", "64", "--seed", "9",
+             "--alpha", "0.9", "--quantiles", "0.1,0.9",
+             "--screen-tier", "1", "--backend", "cpu",
+             "--screening-only"])
+        assert args.samples == 64 and args.seed == 9
+        assert args.screen_tier == 1 and args.screening_only
+        assert _quantiles(args.quantiles) == (0.1, 0.9)
+        with pytest.raises(ParameterError):
+            _quantiles("a,b")
+
+
+# ---------------------------------------------------------------------------
+# Risk-aware design frontier
+# ---------------------------------------------------------------------------
+
+class TestRiskAwareDesign:
+    def _dspec(self, **over):
+        base = dict(
+            bounds={("Battery", "1"): DERBounds(kw=(500.0, 2500.0),
+                                                kwh=(1000.0, 9000.0))},
+            population=4, top_k=2, refine_rounds=0)
+        base.update(over)
+        return DesignSpec(**base)
+
+    def test_risk_block_validates_lazily(self):
+        with pytest.raises(ParameterError, match="unknown field"):
+            self._dspec(risk={"bogus": 1}).validate()
+        with pytest.raises(ParameterError, match="object"):
+            self._dspec(risk="yes").validate()
+        spec = self._dspec(risk={}).validate()
+        # design risk defaults to a 256-draw cloud per finalist
+        assert spec.normalized()["risk"]["n_samples"] == 256
+        assert self._dspec().normalized()["risk"] is None
+
+    def test_cvar_axis_changes_dominance(self):
+        capex = [1.0, 2.0]
+        value = [1.0, 2.0]
+        # without risk, design 1 is dominated outright ...
+        assert dominated_mask(capex, value).tolist() == [False, True]
+        # ... but buying tail-risk protection keeps it on the frontier
+        assert dominated_mask(capex, value,
+                              cvar=[2.0, 1.0]).tolist() == [False, False]
+        # a strictly-worse-everywhere design stays dominated
+        assert dominated_mask([1.0, 1.0], [1.0, 1.0],
+                              cvar=[1.0, 2.0]).tolist() == [False, True]
+
+    def test_risk_mode_one_shot_frontier(self):
+        spec = self._dspec(
+            risk={"samples": 3, "seed": 1, "alpha": 0.75}).validate()
+        fr = run_design(_case(), spec, backend="jax")
+        for col in ("mc_mean", "mc_cvar", "mc_samples", "mc_alpha",
+                    "mc_quarantined"):
+            assert col in fr.frontier.columns
+        assert fr.all_finalists_certified
+        assert (fr.frontier["mc_samples"] == 3).all()
+        assert np.isfinite(fr.frontier["mc_mean"]).all()
+        assert np.isfinite(fr.frontier["mc_cvar"]).all()
+        # CVaR is an upper-tail cost statistic: never below the mean tail
+        assert (fr.frontier["mc_cvar"] >=
+                fr.frontier["mc_mean"] - 1e-9).all()
+        assert fr.spec["risk"]["n_samples"] == 3
+
+    def test_risk_mode_through_the_service(self):
+        spec = self._dspec(
+            risk={"samples": 2, "seed": 1, "alpha": 0.75}).validate()
+        svc = ScenarioService(backend="jax", max_wait_s=0.0)
+        fut = svc.submit_design(_case(), spec, request_id="dr1")
+        assert svc.run_once() == 1
+        fr = fut.result(0)
+        assert fr.fidelity == "certified"
+        assert fr.all_finalists_certified
+        assert (fr.frontier["mc_samples"] == 2).all()
+        assert np.isfinite(fr.frontier["mc_cvar"]).all()
+        svc.close()
